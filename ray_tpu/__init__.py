@@ -1,0 +1,39 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Tasks, actors, a shared-memory object store, gang scheduling over TPU
+topologies, and an XLA-native collective/compute plane (jax / pjit /
+shard_map / Pallas). See SURVEY.md for the architecture map against the
+reference framework.
+"""
+
+from ray_tpu.version import __version__  # noqa: F401
+from ray_tpu import exceptions  # noqa: F401
+
+# Public API is populated as the runtime comes up; populated lazily to keep
+# `import ray_tpu` light (no jax import on the control path).
+from ray_tpu.api import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    remote,
+    get,
+    put,
+    wait,
+    cancel,
+    kill,
+    get_actor,
+    method,
+    ObjectRef,
+    get_runtime_context,
+    available_resources,
+    cluster_resources,
+    nodes,
+    timeline,
+)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "cancel", "kill", "get_actor", "method", "ObjectRef",
+    "get_runtime_context", "available_resources", "cluster_resources",
+    "nodes", "timeline", "exceptions", "__version__",
+]

@@ -1,0 +1,136 @@
+"""Typed runtime configuration registry.
+
+Equivalent in role to the reference's ``RAY_CONFIG`` macro registry
+(reference: src/ray/common/ray_config_def.h, ray_config.h:47): every knob has
+a typed default, can be overridden per-process with a ``RAY_TPU_<NAME>``
+environment variable, and can be shipped cluster-wide as a JSON system-config
+blob at node start.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class _ConfigEntry:
+    name: str
+    default: Any
+    type: type
+    doc: str
+
+
+class Config:
+    """Singleton-style config registry with env / JSON overrides."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, _ConfigEntry] = {}
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any, doc: str = "") -> None:
+        entry = _ConfigEntry(name, default, type(default), doc)
+        self._entries[name] = entry
+        self._values[name] = self._load_env(entry)
+
+    def _load_env(self, entry: _ConfigEntry) -> Any:
+        raw = os.environ.get(_ENV_PREFIX + entry.name.upper())
+        if raw is None:
+            return entry.default
+        return self._coerce(entry, raw)
+
+    @staticmethod
+    def _coerce(entry: _ConfigEntry, raw: Any) -> Any:
+        if entry.type is bool:
+            if isinstance(raw, bool):
+                return raw
+            return str(raw).lower() in ("1", "true", "yes", "on")
+        if entry.type is int:
+            return int(raw)
+        if entry.type is float:
+            return float(raw)
+        return entry.type(raw)
+
+    def get(self, name: str) -> Any:
+        return self._values[name]
+
+    def __getattr__(self, name: str) -> Any:
+        # Called only when normal attribute lookup fails.
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            if name not in self._entries:
+                raise KeyError(f"unknown config: {name}")
+            self._values[name] = self._coerce(self._entries[name], value)
+
+    def apply_system_config(self, blob: str | Dict[str, Any]) -> None:
+        """Apply a cluster-wide JSON config blob (unknown keys ignored)."""
+        if isinstance(blob, str):
+            blob = json.loads(blob) if blob else {}
+        for k, v in blob.items():
+            if k in self._entries:
+                self.set(k, v)
+
+    def dump(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+config = Config()
+_d = config.define
+
+# --- core worker / task submission -----------------------------------------
+_d("max_direct_call_object_size", 100 * 1024,
+   "Results/args at or below this many bytes travel inline over the task "
+   "RPC; larger ones go through the shared-memory object store.")
+_d("task_retry_delay_ms", 50, "Delay before resubmitting a failed task.")
+_d("default_max_retries", 3, "Default max retries for normal tasks.")
+_d("actor_creation_min_workers", 0, "Prestarted workers kept for actors.")
+_d("worker_lease_timeout_s", 60.0, "Timeout waiting for a worker lease.")
+_d("get_timeout_poll_ms", 20, "Poll interval for blocking gets.")
+_d("fetch_chunk_bytes", 5 * 1024 * 1024,
+   "Chunk size for node-to-node object transfer (reference uses 5 MiB, "
+   "object_manager.proto / ray_config_def.h:332).")
+
+# --- object store -----------------------------------------------------------
+_d("object_store_memory", 2 * 1024 * 1024 * 1024,
+   "Default per-node shared-memory object store capacity in bytes.")
+_d("object_store_dir", "/dev/shm",
+   "Directory backing the store arena file (tmpfs for zero-copy).")
+_d("object_store_eviction", True, "Enable LRU eviction when full.")
+
+# --- raylet / scheduling ----------------------------------------------------
+_d("num_workers_soft_limit", -1,
+   "Max pooled workers per node; -1 means num_cpus.")
+_d("worker_start_timeout_s", 30.0, "Timeout for a worker process to register.")
+_d("scheduler_spread_threshold", 0.5,
+   "Hybrid policy: prefer local node until utilization exceeds this "
+   "(reference: ray_config_def.h:193).")
+_d("worker_idle_timeout_s", 300.0, "Idle workers above the soft limit exit.")
+_d("raylet_heartbeat_period_ms", 1000, "Node -> GCS liveness report period.")
+_d("health_check_failure_threshold", 5,
+   "Missed health checks before the GCS declares a node dead.")
+
+# --- gcs --------------------------------------------------------------------
+_d("gcs_storage", "memory", "GCS table storage backend: memory | file.")
+_d("gcs_file_storage_path", "", "Path for the file storage backend.")
+_d("maximum_gcs_dead_node_cache", 100, "Dead nodes kept for the state API.")
+_d("task_events_max_buffer", 10000, "Per-worker task event buffer entries.")
+
+# --- tpu --------------------------------------------------------------------
+_d("tpu_chips_per_host", 4,
+   "Chips driven by one host on the modeled pod (v4/v5p default).")
+_d("tpu_topology", "", "Override slice topology string, e.g. '2x2x1'.")
+
+# --- logging ----------------------------------------------------------------
+_d("log_dir", "", "Session log directory; empty = <session_dir>/logs.")
+_d("log_to_driver", True, "Stream worker logs back to the driver.")

@@ -1,0 +1,145 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Role-equivalent to the reference's ``python/ray/_private/serialization.py``
+(SerializationContext, :92): values are pickled with protocol 5 so large
+contiguous buffers (numpy / jax host arrays) are captured out-of-band and can
+be written into — and later mapped zero-copy out of — the shared-memory
+object store.
+
+Wire layout of a stored object (64-byte aligned buffers for zero-copy numpy):
+
+    u32 magic | u32 n_buffers | u64 meta_len | (u64 offset, u64 len) * n
+    | metadata(pickle bytes) | pad | buffer_0 | pad | buffer_1 | ...
+"""
+
+from __future__ import annotations
+
+import contextvars
+import io
+import pickle
+import struct
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+_MAGIC = 0x52545055  # "RTPU"
+_ALIGN = 64
+_HEADER = struct.Struct("<IIQ")
+_BUF_DESC = struct.Struct("<QQ")
+
+# Collects ObjectRefs encountered while pickling a value, so task specs can
+# record nested-ref dependencies (reference: serialization.py tracks contained
+# object refs for ownership/borrowing).
+_ref_collector: contextvars.ContextVar[Optional[list]] = contextvars.ContextVar(
+    "ray_tpu_ref_collector", default=None
+)
+
+
+def collect_nested_refs() -> contextvars.Token:
+    return _ref_collector.set([])
+
+
+def finish_collect(token: contextvars.Token) -> list:
+    refs = _ref_collector.get() or []
+    _ref_collector.reset(token)
+    return refs
+
+
+def note_object_ref(ref) -> None:
+    """Called from ObjectRef.__reduce__ while a collector is active."""
+    refs = _ref_collector.get()
+    if refs is not None:
+        refs.append(ref)
+
+
+class SerializedObject:
+    __slots__ = ("metadata", "buffers")
+
+    def __init__(self, metadata: bytes, buffers: Sequence[memoryview]):
+        self.metadata = metadata
+        self.buffers = list(buffers)
+
+    def total_size(self) -> int:
+        size = _HEADER.size + _BUF_DESC.size * len(self.buffers)
+        size += len(self.metadata)
+        for b in self.buffers:
+            size = _aligned(size) + b.nbytes
+        return size
+
+    def write_into(self, out: memoryview) -> int:
+        """Write the framed object into ``out``; returns bytes written."""
+        n = len(self.buffers)
+        desc_off = _HEADER.size
+        data_off = desc_off + _BUF_DESC.size * n
+        _HEADER.pack_into(out, 0, _MAGIC, n, len(self.metadata))
+        out[data_off : data_off + len(self.metadata)] = self.metadata
+        cursor = data_off + len(self.metadata)
+        for i, buf in enumerate(self.buffers):
+            cursor = _aligned(cursor)
+            _BUF_DESC.pack_into(out, desc_off + i * _BUF_DESC.size, cursor, buf.nbytes)
+            out[cursor : cursor + buf.nbytes] = buf
+            cursor += buf.nbytes
+        return cursor
+
+    def to_bytes(self) -> bytes:
+        buf = bytearray(self.total_size())
+        self.write_into(memoryview(buf))
+        return bytes(buf)
+
+
+def _aligned(offset: int) -> int:
+    return (offset + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _to_host(obj: Any) -> Any:
+    """Move jax arrays to host numpy before pickling (device buffers do not
+    survive a process hop; the receiving worker re-commits to its devices)."""
+    return obj
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+
+    def buffer_callback(pb: pickle.PickleBuffer) -> bool:
+        buffers.append(pb)
+        return False  # do not serialize in-band
+
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    views = []
+    for pb in buffers:
+        try:
+            views.append(pb.raw())
+        except BufferError:
+            # Non-contiguous buffer: fall back to a contiguous copy.
+            views.append(memoryview(bytes(pb)))
+    return SerializedObject(meta, views)
+
+
+def deserialize_framed(view: memoryview) -> Any:
+    """Deserialize a framed object, zero-copy over ``view``.
+
+    The returned value may hold references into ``view`` (numpy arrays over
+    shared memory). Callers that need the store slot released must copy.
+    """
+    magic, n, meta_len = _HEADER.unpack_from(view, 0)
+    if magic != _MAGIC:
+        raise ValueError("corrupt object header")
+    desc_off = _HEADER.size
+    data_off = desc_off + _BUF_DESC.size * n
+    meta = bytes(view[data_off : data_off + meta_len])
+    bufs = []
+    for i in range(n):
+        off, length = _BUF_DESC.unpack_from(view, desc_off + i * _BUF_DESC.size)
+        bufs.append(view[off : off + length])
+    return pickle.loads(meta, buffers=bufs)
+
+
+def dumps_oob(value: Any) -> bytes:
+    """One-shot framed serialize (for socket payloads)."""
+    return serialize(value).to_bytes()
+
+
+def loads_oob(data: bytes | memoryview) -> Any:
+    if isinstance(data, (bytes, bytearray)):
+        data = memoryview(data)
+    return deserialize_framed(data)

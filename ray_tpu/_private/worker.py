@@ -1,0 +1,21 @@
+"""Core worker runtime (placeholder; full implementation in progress)."""
+
+
+class ObjectRef:
+    pass
+
+
+def init(**kwargs):
+    raise NotImplementedError
+
+
+def shutdown():
+    pass
+
+
+def global_worker():
+    return None
+
+
+def require_worker():
+    raise RuntimeError("ray_tpu.init() has not been called")

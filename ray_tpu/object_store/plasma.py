@@ -1,0 +1,259 @@
+"""Python client for the native shared-memory object store.
+
+Role-equivalent to the reference's plasma client
+(reference: src/ray/object_manager/plasma/client.h) but server-less: the C++
+library (``store.cpp``) keeps all store state inside one mmap'd tmpfs arena,
+so create/seal/get are direct library calls — no socket round-trip and
+zero-copy reads for every process on the node.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from ray_tpu._private import serialization
+from ray_tpu.exceptions import OutOfMemoryError
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_DIR, "librtpu_store.so")
+_SRC_PATH = os.path.join(_DIR, "store.cpp")
+
+RTPU_OK = 0
+RTPU_EXISTS = -1
+RTPU_OOM = -2
+RTPU_TIMEOUT = -3
+RTPU_NOT_FOUND = -4
+RTPU_BAD_STATE = -5
+RTPU_FULL_TABLE = -6
+RTPU_IO = -7
+
+ID_SIZE = 28
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _ensure_built() -> str:
+    """Compile the store library on first use (no install step needed)."""
+    with _build_lock:
+        if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(
+            _SRC_PATH
+        ):
+            return _SO_PATH
+        tmp = _SO_PATH + f".tmp.{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-pthread",
+            "-o", tmp, _SRC_PATH,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, _SO_PATH)
+        return _SO_PATH
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_ensure_built())
+    u64, i64, i32 = ctypes.c_uint64, ctypes.c_int64, ctypes.c_int32
+    p = ctypes.c_void_p
+    cp = ctypes.c_char_p
+    bp = ctypes.POINTER(ctypes.c_uint8)
+
+    lib.rtpu_store_init.argtypes = [cp, u64, u64]
+    lib.rtpu_store_init.restype = ctypes.c_int
+    lib.rtpu_store_attach.argtypes = [cp]
+    lib.rtpu_store_attach.restype = p
+    lib.rtpu_store_detach.argtypes = [p]
+    lib.rtpu_store_detach.restype = None
+    lib.rtpu_store_base.argtypes = [p]
+    lib.rtpu_store_base.restype = p
+    lib.rtpu_store_capacity.argtypes = [p]
+    lib.rtpu_store_capacity.restype = u64
+    lib.rtpu_create.argtypes = [p, cp, u64, ctypes.POINTER(u64)]
+    lib.rtpu_create.restype = ctypes.c_int
+    lib.rtpu_seal.argtypes = [p, cp]
+    lib.rtpu_seal.restype = ctypes.c_int
+    lib.rtpu_abort.argtypes = [p, cp]
+    lib.rtpu_abort.restype = ctypes.c_int
+    lib.rtpu_get.argtypes = [p, cp, i64, ctypes.POINTER(u64), ctypes.POINTER(u64)]
+    lib.rtpu_get.restype = ctypes.c_int
+    lib.rtpu_release.argtypes = [p, cp]
+    lib.rtpu_release.restype = ctypes.c_int
+    lib.rtpu_delete.argtypes = [p, cp]
+    lib.rtpu_delete.restype = ctypes.c_int
+    lib.rtpu_contains.argtypes = [p, cp]
+    lib.rtpu_contains.restype = ctypes.c_int
+    lib.rtpu_info.argtypes = [p, cp, ctypes.POINTER(u64), ctypes.POINTER(i32),
+                              ctypes.POINTER(i32)]
+    lib.rtpu_info.restype = ctypes.c_int
+    lib.rtpu_stats.argtypes = [p] + [ctypes.POINTER(u64)] * 4
+    lib.rtpu_stats.restype = None
+    lib.rtpu_list.argtypes = [p, bp, u64]
+    lib.rtpu_list.restype = u64
+    _lib = lib
+    return lib
+
+
+def create_store(path: str, capacity: int, max_objects: int = 1 << 16) -> None:
+    lib = _load_lib()
+    rc = lib.rtpu_store_init(path.encode(), capacity, max_objects)
+    if rc != RTPU_OK:
+        raise OSError(f"failed to initialize object store at {path}: rc={rc}")
+
+
+class StoreFullError(OutOfMemoryError):
+    pass
+
+
+class ObjectExistsError(Exception):
+    pass
+
+
+class PlasmaClient:
+    """Per-process connection to the node's shared-memory store."""
+
+    def __init__(self, path: str):
+        self._lib = _load_lib()
+        self._path = path
+        self._handle = self._lib.rtpu_store_attach(path.encode())
+        if not self._handle:
+            raise OSError(f"failed to attach to object store at {path}")
+        # Map the arena file for zero-copy buffer access from Python.
+        self._fd = os.open(path, os.O_RDWR)
+        self._map = mmap.mmap(self._fd, 0)
+        self._view = memoryview(self._map)
+        self._closed = False
+
+    # -- raw byte-level API ---------------------------------------------------
+
+    def create(self, object_id: bytes, size: int) -> memoryview:
+        off = ctypes.c_uint64()
+        rc = self._lib.rtpu_create(self._handle, object_id, size, ctypes.byref(off))
+        if rc == RTPU_EXISTS:
+            raise ObjectExistsError(object_id.hex())
+        if rc in (RTPU_OOM, RTPU_FULL_TABLE):
+            raise StoreFullError(
+                f"object store full creating {size} bytes (rc={rc})"
+            )
+        if rc != RTPU_OK:
+            raise OSError(f"create failed rc={rc}")
+        return self._view[off.value : off.value + size]
+
+    def seal(self, object_id: bytes) -> None:
+        rc = self._lib.rtpu_seal(self._handle, object_id)
+        if rc != RTPU_OK:
+            raise OSError(f"seal failed rc={rc}")
+
+    def abort(self, object_id: bytes) -> None:
+        self._lib.rtpu_abort(self._handle, object_id)
+
+    def get_buffer(self, object_id: bytes, timeout_ms: int = -1) -> Optional[memoryview]:
+        """Pinned zero-copy view of a sealed object; None on timeout/missing.
+
+        Callers must ``release`` when done with the view.
+        """
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rtpu_get(self._handle, object_id, timeout_ms,
+                                ctypes.byref(off), ctypes.byref(size))
+        if rc in (RTPU_TIMEOUT, RTPU_NOT_FOUND):
+            return None
+        if rc != RTPU_OK:
+            raise OSError(f"get failed rc={rc}")
+        return self._view[off.value : off.value + size.value]
+
+    def release(self, object_id: bytes) -> None:
+        self._lib.rtpu_release(self._handle, object_id)
+
+    def delete(self, object_id: bytes) -> bool:
+        return self._lib.rtpu_delete(self._handle, object_id) == RTPU_OK
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.rtpu_contains(self._handle, object_id))
+
+    def stats(self) -> dict:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        ev = ctypes.c_uint64()
+        self._lib.rtpu_stats(self._handle, ctypes.byref(used), ctypes.byref(cap),
+                             ctypes.byref(n), ctypes.byref(ev))
+        return {
+            "used_bytes": used.value,
+            "capacity_bytes": cap.value,
+            "num_objects": n.value,
+            "evictions": ev.value,
+        }
+
+    def list_objects(self, max_n: int = 4096) -> list:
+        buf = (ctypes.c_uint8 * (max_n * ID_SIZE))()
+        n = self._lib.rtpu_list(self._handle, buf, max_n)
+        raw = bytes(buf)
+        return [raw[i * ID_SIZE : (i + 1) * ID_SIZE] for i in range(n)]
+
+    # -- value-level API ------------------------------------------------------
+
+    def put_value(self, object_id: bytes, value) -> int:
+        """Serialize and store a Python value; returns stored size."""
+        sobj = serialization.serialize(value)
+        size = sobj.total_size()
+        buf = self.create(object_id, size)
+        try:
+            sobj.write_into(buf)
+        except BaseException:
+            del buf
+            self.abort(object_id)
+            raise
+        del buf  # drop the memoryview before any later delete/eviction
+        self.seal(object_id)
+        return size
+
+    def put_serialized(self, object_id: bytes, sobj) -> int:
+        size = sobj.total_size()
+        buf = self.create(object_id, size)
+        try:
+            sobj.write_into(buf)
+        finally:
+            del buf
+        self.seal(object_id)
+        return size
+
+    def get_value(self, object_id: bytes, timeout_ms: int = -1):
+        """Deserialize a stored value.
+
+        Buffers are copied out of the arena before unpickling so the slot can
+        be evicted safely after release. (A pinned zero-copy path exists via
+        ``get_buffer`` for callers that manage the pin lifetime themselves.)
+        """
+        view = self.get_buffer(object_id, timeout_ms)
+        if view is None:
+            return None, False
+        try:
+            data = bytes(view)  # copy out; keeps eviction decoupled from GC
+        finally:
+            del view
+            self.release(object_id)
+        return serialization.loads_oob(data), True
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._view.release()
+            self._map.close()
+            os.close(self._fd)
+        finally:
+            self._lib.rtpu_store_detach(self._handle)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,38 @@
+"""Test configuration.
+
+JAX must run on a virtual 8-device CPU mesh for all tests (the TPU tunnel is
+single-chip; sharding tests need a mesh), so set the platform flags before
+jax is ever imported.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2_cpus():
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()

@@ -1,0 +1,36 @@
+import os
+
+from ray_tpu._private.config import Config
+
+
+def test_defaults_and_set():
+    c = Config()
+    c.define("foo_ms", 100, "doc")
+    assert c.foo_ms == 100
+    c.set("foo_ms", "250")
+    assert c.foo_ms == 250
+
+
+def test_env_override():
+    os.environ["RAY_TPU_BAR_ENABLED"] = "true"
+    try:
+        c = Config()
+        c.define("bar_enabled", False)
+        assert c.bar_enabled is True
+    finally:
+        del os.environ["RAY_TPU_BAR_ENABLED"]
+
+
+def test_system_config_blob():
+    c = Config()
+    c.define("x", 1)
+    c.define("y", 2.5)
+    c.apply_system_config('{"x": 9, "y": 1.5, "unknown": 3}')
+    assert c.x == 9 and c.y == 1.5
+
+
+def test_global_config_has_core_knobs():
+    from ray_tpu._private.config import config
+
+    assert config.max_direct_call_object_size > 0
+    assert 0 < config.scheduler_spread_threshold <= 1

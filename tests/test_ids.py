@@ -1,0 +1,70 @@
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+)
+
+
+def test_sizes():
+    assert len(JobID.from_int(1).binary()) == 4
+    job = JobID.from_int(7)
+    actor = ActorID.of(job)
+    assert len(actor.binary()) == 16
+    task = TaskID.for_actor_task(actor)
+    assert len(task.binary()) == 24
+    obj = ObjectID.for_return(task, 0)
+    assert len(obj.binary()) == 28
+
+
+def test_embedding_roundtrip():
+    job = JobID.from_int(42)
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    task = TaskID.for_actor_task(actor)
+    assert task.actor_id() == actor
+    assert task.job_id() == job
+    obj = ObjectID.for_return(task, 3)
+    assert obj.task_id() == task
+    assert obj.index() == 3
+    assert obj.job_id() == job
+    assert not obj.is_put()
+    put = ObjectID.for_put(task, 1)
+    assert put.is_put()
+    assert put.task_id() == task
+
+
+def test_normal_task_has_nil_actor():
+    job = JobID.from_int(1)
+    task = TaskID.for_task(job)
+    assert task.job_id() == job
+    assert task.actor_id().binary()[:12] == b"\xff" * 12
+
+
+def test_hex_and_equality():
+    n = NodeID.from_random()
+    assert NodeID.from_hex(n.hex()) == n
+    assert n != NodeID.from_random()
+    assert len({n, NodeID(n.binary())}) == 1
+
+
+def test_nil():
+    assert TaskID.nil().is_nil()
+    assert not TaskID.for_task(JobID.from_int(1)).is_nil()
+
+
+def test_placement_group_id():
+    job = JobID.from_int(9)
+    pg = PlacementGroupID.of(job)
+    assert len(pg.binary()) == 18
+    assert pg.job_id() == job
+
+
+def test_pickle_roundtrip():
+    import pickle
+
+    job = JobID.from_int(5)
+    obj = ObjectID.for_return(TaskID.for_task(job), 2)
+    assert pickle.loads(pickle.dumps(obj)) == obj

@@ -1,0 +1,62 @@
+import numpy as np
+
+from ray_tpu._private import serialization as ser
+
+
+def test_roundtrip_simple():
+    for v in [1, "x", None, {"a": [1, 2, (3, 4)]}, b"bytes"]:
+        assert ser.loads_oob(ser.dumps_oob(v)) == v
+
+
+def test_numpy_out_of_band_zero_copy():
+    arr = np.arange(1 << 16, dtype=np.float32)
+    sobj = ser.serialize({"w": arr, "tag": "x"})
+    # The array must have gone out-of-band, not into the pickle stream.
+    assert len(sobj.metadata) < arr.nbytes // 2
+    assert sum(b.nbytes for b in sobj.buffers) >= arr.nbytes
+    back = ser.loads_oob(sobj.to_bytes())
+    np.testing.assert_array_equal(back["w"], arr)
+
+
+def test_zero_copy_view_shares_memory():
+    arr = np.arange(1024, dtype=np.int64)
+    data = ser.dumps_oob(arr)
+    view = memoryview(bytearray(data))
+    back = ser.deserialize_framed(view)
+    np.testing.assert_array_equal(back, arr)
+    # Mutating the backing view must show through (proves zero-copy).
+    back2 = ser.deserialize_framed(view)
+    view_arr = np.frombuffer(view, dtype=np.int64,
+                             count=1024, offset=data.index(arr[:8].tobytes()))
+    view_arr[0] = 999
+    assert back2[0] == 999
+
+
+def test_alignment():
+    arr = np.ones(100, dtype=np.float64)
+    sobj = ser.serialize(arr)
+    data = sobj.to_bytes()
+    back = ser.loads_oob(data)
+    # 64-byte alignment lets numpy map the buffer without copying.
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_function_roundtrip():
+    def f(x):
+        return x * 2
+
+    g = ser.loads_oob(ser.dumps_oob(f))
+    assert g(21) == 42
+
+
+def test_exception_roundtrip():
+    from ray_tpu.exceptions import RayTaskError
+
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        err = RayTaskError.from_exception("f", e)
+    back = ser.loads_oob(ser.dumps_oob(err))
+    assert isinstance(back, RayTaskError)
+    assert "boom" in back.traceback_str
+    assert isinstance(back.as_instanceof_cause(), ValueError)
