@@ -1,0 +1,1036 @@
+"""GCS: the cluster control plane.
+
+Role-equivalent to the reference's GCS server
+(reference: src/ray/gcs/gcs_server/gcs_server.h:77) collapsed into one
+Python service: node membership (gcs_node_manager.h:41), actor directory +
+scheduling (gcs_actor_manager.h:281, gcs_actor_scheduler.h:111), placement
+groups (gcs_placement_group_manager.h:223), internal KV + function store
+(gcs_kv_manager.h:101, function_manager.py:56), task scheduling with
+resource accounting (the reference splits this between GCS and raylets;
+here the GCS owns the authoritative resource view and leases tasks to node
+managers), the object directory (ownership_based_object_directory.h:37), and
+task events (gcs_task_manager.h:61).
+
+Threading model: handlers run on per-connection listener threads; all state
+is guarded by one lock (the analog of the reference's single-threaded asio
+loop, common/asio/). Handlers never block while holding the lock — deferred
+replies are parked and fulfilled by later events or the timer thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import protocol
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu._private.task_spec import (
+    ActorCreationSpec,
+    ActorTaskSpec,
+    Bundle,
+    PlacementGroupSpec,
+    ResourceSet,
+    TaskSpec,
+)
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+# Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class NodeEntry:
+    node_id: str
+    address: str                      # node manager server address (pull/push)
+    store_path: str
+    conn: protocol.Conn
+    total: ResourceSet
+    available: ResourceSet
+    labels: Dict[str, str] = field(default_factory=dict)
+    is_head: bool = False
+    alive: bool = True
+    started_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class ActorEntry:
+    spec: ActorCreationSpec
+    state: str = DEPENDENCIES_UNREADY
+    node_id: Optional[str] = None
+    restarts_left: int = 0
+    num_restarts: int = 0
+    death_cause: str = ""
+    waiters: List[Tuple[protocol.Conn, int]] = field(default_factory=list)
+    pending_tasks: List[ActorTaskSpec] = field(default_factory=list)
+
+
+@dataclass
+class PgEntry:
+    spec: PlacementGroupSpec
+    state: str = "PENDING"            # PENDING | CREATED | REMOVED
+    waiters: List[Tuple[protocol.Conn, int]] = field(default_factory=list)
+    # index -> ResourceSet of remaining bundle capacity
+    bundle_available: Dict[int, ResourceSet] = field(default_factory=dict)
+
+
+@dataclass
+class _ObjWaiter:
+    conn: protocol.Conn
+    msg_id: int
+    pending: Set[bytes]               # object ids not yet ready
+    num_needed: int                   # how many of the original set must be ready
+    ready: Set[bytes] = field(default_factory=set)
+    failed: Set[bytes] = field(default_factory=set)
+    deadline: Optional[float] = None
+
+
+class GcsServer:
+    """The head control-plane service."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, NodeEntry] = {}
+        self._clients: Dict[str, protocol.Conn] = {}
+        self._client_jobs: Dict[str, JobID] = {}
+        self._next_job = 0
+
+        # function / class store + generic KV (namespaced)
+        self._functions: Dict[str, bytes] = {}
+        self._kv: Dict[str, Dict[bytes, bytes]] = collections.defaultdict(dict)
+
+        # task scheduling
+        self._queued_tasks: collections.deque = collections.deque()
+        self._waiting_tasks: Dict[bytes, List[TaskSpec]] = collections.defaultdict(list)
+        self._running_tasks: Dict[bytes, Tuple[TaskSpec, str]] = {}  # task_id -> (spec, node)
+        self._cancelled_tasks: Set[bytes] = set()
+
+        # actors
+        self._actors: Dict[bytes, ActorEntry] = {}
+        self._named_actors: Dict[Tuple[str, str], bytes] = {}
+
+        # placement groups
+        self._pgs: Dict[bytes, PgEntry] = {}
+        self._named_pgs: Dict[str, bytes] = {}
+
+        # object directory: object_id bytes -> set(node_id); sizes for stats
+        self._obj_locations: Dict[bytes, Set[str]] = collections.defaultdict(set)
+        self._obj_sizes: Dict[bytes, int] = {}
+        self._failed_objects: Dict[bytes, Any] = {}
+        self._obj_waiters: List[_ObjWaiter] = []
+        # object_id -> task that produces it (for "will it ever be ready")
+        self._producing_task: Dict[bytes, bytes] = {}
+
+        # task events ring buffer (reference: gcs_task_manager.h bounded store)
+        self._task_events: collections.deque = collections.deque(maxlen=100_000)
+
+        self._shutdown = threading.Event()
+        self.server = protocol.Server(self._handle, host=host, port=port,
+                                      name="gcs")
+        self.server.on_disconnect = self._on_disconnect
+        self.address = self.server.address
+        self._timer = threading.Thread(target=self._timer_loop, daemon=True,
+                                       name="rtpu-gcs-timer")
+        self._timer.start()
+
+    # ------------------------------------------------------------------ util
+
+    def close(self):
+        self._shutdown.set()
+        # Tell node managers to tear down their worker pools.
+        with self._lock:
+            nodes = list(self._nodes.values())
+        for n in nodes:
+            try:
+                n.conn.notify("shutdown")
+            except Exception:
+                pass
+        self.server.close()
+
+    def _timer_loop(self):
+        while not self._shutdown.wait(0.05):
+            now = time.time()
+            with self._lock:
+                expired = [w for w in self._obj_waiters
+                           if w.deadline is not None and now >= w.deadline]
+                for w in expired:
+                    self._obj_waiters.remove(w)
+            for w in expired:
+                try:
+                    w.conn.reply(w.msg_id, {
+                        "ready": list(w.ready), "timeout": True,
+                        "failed": {o: self._failed_objects.get(o)
+                                   for o in w.failed},
+                    })
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------- dispatch
+
+    def _handle(self, conn: protocol.Conn, mtype: str, payload: Any,
+                msg_id: int):
+        try:
+            fn = getattr(self, "_h_" + mtype, None)
+            if fn is None:
+                conn.reply_error(msg_id, f"gcs: unknown message {mtype}")
+                return
+            fn(conn, payload, msg_id)
+        except Exception as e:
+            logger.exception("gcs handler %s failed", mtype)
+            try:
+                conn.reply_error(msg_id, f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+
+    def _on_disconnect(self, conn: protocol.Conn):
+        role = conn.meta.get("role")
+        with self._lock:
+            if role == "node":
+                node_id = conn.meta.get("node_id")
+                self._mark_node_dead(node_id)
+            elif role in ("driver", "worker"):
+                cid = conn.meta.get("client_id")
+                self._clients.pop(cid, None)
+                if role == "driver":
+                    self._on_driver_exit(cid)
+
+    def _on_driver_exit(self, client_id: str):
+        """Kill this driver's non-detached actors (job cleanup)."""
+        for aid, entry in list(self._actors.items()):
+            if (entry.spec.caller_id == client_id
+                    and entry.spec.lifetime != "detached"
+                    and entry.state not in (DEAD,)):
+                self._kill_actor_locked(aid, no_restart=True,
+                                        cause="owner driver exited")
+
+    def _mark_node_dead(self, node_id: Optional[str]):
+        node = self._nodes.get(node_id) if node_id else None
+        if node is None or not node.alive:
+            return
+        node.alive = False
+        logger.warning("node %s died", node_id)
+        # Drop object locations on that node; fail unrecoverable objects.
+        for oid, locs in list(self._obj_locations.items()):
+            locs.discard(node_id)
+        # Fail running tasks on that node (retry if budget remains).
+        for tid, (spec, n) in list(self._running_tasks.items()):
+            if n == node_id:
+                del self._running_tasks[tid]
+                self._handle_task_failure(spec, "node died")
+        # Restart / fail actors on that node.
+        for aid, entry in self._actors.items():
+            if entry.node_id == node_id and entry.state in (ALIVE, PENDING_CREATION):
+                self._on_actor_down(aid, "node died")
+
+    # --------------------------------------------------------- registration
+
+    def _h_register_client(self, conn, p, msg_id):
+        with self._lock:
+            cid = p["client_id"]
+            conn.meta["role"] = p["role"]
+            conn.meta["client_id"] = cid
+            self._clients[cid] = conn
+            if p["role"] == "driver":
+                self._next_job += 1
+                job = JobID.from_int(self._next_job)
+                self._client_jobs[cid] = job
+            else:
+                job = p.get("job_id")
+            head = next((n for n in self._nodes.values() if n.is_head), None)
+            conn.reply(msg_id, {
+                "job_id": job,
+                "head_store_path": head.store_path if head else None,
+                "head_node_id": head.node_id if head else None,
+            })
+
+    def _h_register_node(self, conn, p, msg_id):
+        with self._lock:
+            entry = NodeEntry(
+                node_id=p["node_id"],
+                address=p["address"],
+                store_path=p["store_path"],
+                conn=conn,
+                total=ResourceSet(p["resources"]),
+                available=ResourceSet(p["resources"]),
+                labels=p.get("labels", {}),
+                is_head=p.get("is_head", False),
+            )
+            conn.meta["role"] = "node"
+            conn.meta["node_id"] = p["node_id"]
+            self._nodes[p["node_id"]] = entry
+            conn.reply(msg_id, {"ok": True})
+            self._try_schedule()
+            self._try_schedule_pgs()
+
+    def _h_nodes(self, conn, p, msg_id):
+        with self._lock:
+            out = []
+            for n in self._nodes.values():
+                out.append({
+                    "NodeID": n.node_id,
+                    "Alive": n.alive,
+                    "NodeManagerAddress": n.address,
+                    "StorePath": n.store_path,
+                    "Resources": n.total.to_dict(),
+                    "Available": n.available.to_dict(),
+                    "Labels": dict(n.labels),
+                    "IsHead": n.is_head,
+                })
+            conn.reply(msg_id, out)
+
+    def _h_cluster_resources(self, conn, p, msg_id):
+        with self._lock:
+            total = ResourceSet()
+            for n in self._nodes.values():
+                if n.alive:
+                    total.add(n.total.to_dict())
+            conn.reply(msg_id, total.to_dict())
+
+    def _h_available_resources(self, conn, p, msg_id):
+        with self._lock:
+            total = ResourceSet()
+            for n in self._nodes.values():
+                if n.alive:
+                    total.add(n.available.to_dict())
+            conn.reply(msg_id, total.to_dict())
+
+    # ------------------------------------------------------ function store
+
+    def _h_put_function(self, conn, p, msg_id):
+        with self._lock:
+            self._functions.setdefault(p["key"], p["blob"])
+        conn.reply(msg_id, True)
+
+    def _h_get_function(self, conn, p, msg_id):
+        with self._lock:
+            blob = self._functions.get(p["key"])
+        conn.reply(msg_id, blob)
+
+    # ----------------------------------------------------------------- KV
+
+    def _h_kv_put(self, conn, p, msg_id):
+        with self._lock:
+            ns = self._kv[p.get("ns", "")]
+            if not p.get("overwrite", True) and p["key"] in ns:
+                conn.reply(msg_id, False)
+                return
+            ns[p["key"]] = p["value"]
+        conn.reply(msg_id, True)
+
+    def _h_kv_get(self, conn, p, msg_id):
+        with self._lock:
+            conn.reply(msg_id, self._kv[p.get("ns", "")].get(p["key"]))
+
+    def _h_kv_del(self, conn, p, msg_id):
+        with self._lock:
+            conn.reply(msg_id,
+                       self._kv[p.get("ns", "")].pop(p["key"], None) is not None)
+
+    def _h_kv_exists(self, conn, p, msg_id):
+        with self._lock:
+            conn.reply(msg_id, p["key"] in self._kv[p.get("ns", "")])
+
+    def _h_kv_keys(self, conn, p, msg_id):
+        pref = p.get("prefix", b"")
+        with self._lock:
+            conn.reply(msg_id, [k for k in self._kv[p.get("ns", "")]
+                                if k.startswith(pref)])
+
+    # ------------------------------------------------------ task scheduling
+
+    def _deps_ready(self, deps: List[ObjectID]) -> bool:
+        return all(d.binary() in self._obj_locations
+                   and self._obj_locations[d.binary()] for d in deps)
+
+    def _unready_deps(self, deps: List[ObjectID]):
+        return [d for d in deps
+                if not self._obj_locations.get(d.binary())]
+
+    def _h_submit_task(self, conn, spec: TaskSpec, msg_id):
+        with self._lock:
+            spec.retries_left = spec.max_retries
+            for rid in spec.return_ids():
+                self._producing_task[rid.binary()] = spec.task_id.binary()
+            self._enqueue_task(spec)
+            self._try_schedule()
+
+    def _enqueue_task(self, spec: TaskSpec):
+        unready = self._unready_deps(spec.arg_deps)
+        if unready:
+            for d in unready:
+                self._waiting_tasks[d.binary()].append(spec)
+        else:
+            self._queued_tasks.append(spec)
+
+    def _pick_node(self, resources: Dict[str, float],
+                   strategy: Any = None,
+                   preferred: Optional[str] = None) -> Optional[NodeEntry]:
+        """Hybrid scheduling policy (reference:
+        raylet/scheduling/policy/hybrid_scheduling_policy.h:50): prefer the
+        caller's node while its utilization is below 0.5, else best-fit the
+        least-utilized feasible node. NodeAffinity / spread strategies
+        override."""
+        alive = [n for n in self._nodes.values() if n.alive]
+        if isinstance(strategy, str):
+            strategy = None if strategy == "DEFAULT" else _SpreadShim() \
+                if strategy == "SPREAD" else None
+        if strategy is not None:
+            kind = getattr(strategy, "kind", None)
+            if kind == "node_affinity":
+                n = self._nodes.get(strategy.node_id)
+                if n is not None and n.alive and (
+                        strategy.soft or n.available.fits(resources)):
+                    if n.available.fits(resources):
+                        return n
+                    return None  # hard affinity, wait for capacity
+                if not strategy.soft:
+                    return None
+            elif kind == "spread":
+                feas = [n for n in alive if n.available.fits(resources)]
+                if not feas:
+                    return None
+                return min(feas, key=lambda n: n.available.utilization(n.total))
+        if preferred is not None:
+            pn = self._nodes.get(preferred)
+            if (pn is not None and pn.alive and pn.available.fits(resources)
+                    and pn.available.utilization(pn.total) < 0.5):
+                return pn
+        feasible = [n for n in alive if n.available.fits(resources)]
+        if not feasible:
+            return None
+        return min(feasible, key=lambda n: n.available.utilization(n.total))
+
+    def _acquire_for(self, spec, node: NodeEntry) -> bool:
+        """Reserve resources on a node (or its PG bundle)."""
+        if spec.placement_group_id is not None:
+            pg = self._pgs.get(spec.placement_group_id.binary())
+            if pg is None or pg.state != "CREATED":
+                return False
+            idx = spec.placement_group_bundle_index
+            if idx < 0:
+                # any bundle on this node with capacity
+                for i, avail in pg.bundle_available.items():
+                    if (pg.spec.bundles[i].node_id == node.node_id
+                            and avail.fits(spec.resources)):
+                        idx = i
+                        break
+                else:
+                    return False
+                spec.placement_group_bundle_index = idx
+            return pg.bundle_available[idx].acquire(spec.resources)
+        return node.available.acquire(spec.resources)
+
+    def _release_for(self, spec, node_id: str):
+        if spec.placement_group_id is not None:
+            pg = self._pgs.get(spec.placement_group_id.binary())
+            if pg is not None and spec.placement_group_bundle_index >= 0:
+                avail = pg.bundle_available.get(spec.placement_group_bundle_index)
+                if avail is not None:
+                    avail.release(spec.resources)
+            return
+        node = self._nodes.get(node_id)
+        if node is not None:
+            node.available.release(spec.resources)
+
+    def _node_for_pg_task(self, spec) -> Optional[NodeEntry]:
+        pg = self._pgs.get(spec.placement_group_id.binary())
+        if pg is None or pg.state != "CREATED":
+            return None
+        idx = spec.placement_group_bundle_index
+        for i, b in enumerate(pg.spec.bundles):
+            if idx >= 0 and i != idx:
+                continue
+            if (b.node_id in self._nodes
+                    and pg.bundle_available[i].fits(spec.resources)):
+                return self._nodes[b.node_id]
+        return None
+
+    def _try_schedule(self):
+        """Drain the ready queue onto nodes with capacity."""
+        if not self._nodes:
+            return
+        deferred = []
+        while self._queued_tasks:
+            spec = self._queued_tasks.popleft()
+            if isinstance(spec, _ActorCreationShim):
+                entry = self._actors.get(spec.actor_id.binary())
+                if entry is not None and entry.node_id is None and \
+                        entry.state in (PENDING_CREATION, DEPENDENCIES_UNREADY,
+                                        RESTARTING):
+                    if not self._schedule_actor(entry):
+                        deferred.append(spec)
+                continue
+            if spec.task_id.binary() in self._cancelled_tasks:
+                continue
+            if spec.placement_group_id is not None:
+                node = self._node_for_pg_task(spec)
+            else:
+                node = self._pick_node(spec.resources, spec.scheduling_strategy,
+                                       preferred=spec.owner_node)
+            if node is None or not self._acquire_for(spec, node):
+                deferred.append(spec)
+                continue
+            self._running_tasks[spec.task_id.binary()] = (spec, node.node_id)
+            try:
+                node.conn.notify("lease_task", spec)
+            except Exception:
+                self._running_tasks.pop(spec.task_id.binary(), None)
+                self._release_for(spec, node.node_id)
+                deferred.append(spec)
+        self._queued_tasks.extend(deferred)
+
+    def _h_task_done(self, conn, p, msg_id):
+        """Node manager reports task completion (success or failure)."""
+        with self._lock:
+            tid = p["task_id"]
+            entry = self._running_tasks.pop(tid, None)
+            if entry is not None:
+                spec, node_id = entry
+                self._release_for(spec, node_id)
+            for oid, size in p.get("objects", []):
+                self._add_location(oid, p["node_id"], size)
+            if p["status"] == "crashed" and entry is not None:
+                self._handle_task_failure(entry[0], p.get("error", "worker died"))
+            self._try_schedule()
+
+    def _handle_task_failure(self, spec: TaskSpec, reason: str):
+        """System failure (worker/node death): retry or store error objects."""
+        if spec.retries_left > 0:
+            spec.retries_left -= 1
+            logger.info("retrying task %s (%s); %d retries left",
+                        spec.name, reason, spec.retries_left)
+            self._enqueue_task(spec)
+        else:
+            self._fail_task_objects(spec, reason)
+
+    def _fail_task_objects(self, spec, reason: str):
+        """Ask the owner's node to materialize error objects for the returns."""
+        owner_node = self._nodes.get(getattr(spec, "owner_node", None)) or next(
+            (n for n in self._nodes.values() if n.alive), None)
+        ids = [r.binary() for r in spec.return_ids()]
+        for oid in ids:
+            self._failed_objects[oid] = reason
+        if owner_node is not None:
+            try:
+                owner_node.conn.notify("store_error_objects", {
+                    "object_ids": ids,
+                    "error": reason,
+                    "kind": p_kind(spec),
+                    "name": getattr(spec, "name", ""),
+                })
+            except Exception:
+                pass
+
+    def _h_cancel_task(self, conn, p, msg_id):
+        tid = p["task_id"]
+        with self._lock:
+            self._cancelled_tasks.add(tid)
+            # remove from queues
+            self._queued_tasks = collections.deque(
+                s for s in self._queued_tasks if s.task_id.binary() != tid)
+            for lst in self._waiting_tasks.values():
+                lst[:] = [s for s in lst if s.task_id.binary() != tid]
+            running = self._running_tasks.get(tid)
+            if running is not None:
+                spec, node_id = running
+                node = self._nodes.get(node_id)
+                if node is not None:
+                    node.conn.notify("cancel_task", {
+                        "task_id": tid, "force": p.get("force", False)})
+            else:
+                # Cancelled before dispatch: fail its return objects.
+                spec = self._spec_for_task(tid)
+                if spec is not None:
+                    self._fail_task_objects(spec, "cancelled")
+        conn.reply(msg_id, True)
+
+    def _spec_for_task(self, tid: bytes):
+        for s in self._queued_tasks:
+            if s.task_id.binary() == tid:
+                return s
+        return None
+
+    # ------------------------------------------------------------- objects
+
+    def _add_location(self, oid: bytes, node_id: str, size: int = 0):
+        self._obj_locations[oid].add(node_id)
+        if size:
+            self._obj_sizes[oid] = size
+        # wake tasks waiting on this dep
+        waiting = self._waiting_tasks.pop(oid, None)
+        if waiting:
+            for spec in waiting:
+                if not self._unready_deps(spec.arg_deps):
+                    self._queued_tasks.append(spec)
+                else:
+                    self._enqueue_task(spec)
+        self._fulfill_obj_waiters(oid, failed=False)
+
+    def _fulfill_obj_waiters(self, oid: bytes, failed: bool):
+        done = []
+        for w in self._obj_waiters:
+            if oid in w.pending:
+                w.pending.discard(oid)
+                (w.failed if failed else w.ready).add(oid)
+                if len(w.ready) + len(w.failed) >= w.num_needed or not w.pending:
+                    done.append(w)
+        for w in done:
+            self._obj_waiters.remove(w)
+            try:
+                w.conn.reply(w.msg_id, {
+                    "ready": list(w.ready),
+                    "failed": {o: self._failed_objects.get(o, "failed")
+                               for o in w.failed},
+                    "timeout": False,
+                })
+            except Exception:
+                pass
+
+    def _h_add_object_locations(self, conn, p, msg_id):
+        with self._lock:
+            for oid, size in p["objects"]:
+                self._add_location(oid, p["node_id"], size)
+            self._try_schedule()
+
+    def _h_remove_object_location(self, conn, p, msg_id):
+        with self._lock:
+            locs = self._obj_locations.get(p["object_id"])
+            if locs is not None:
+                locs.discard(p["node_id"])
+
+    def _h_object_locations(self, conn, p, msg_id):
+        with self._lock:
+            out = {}
+            for oid in p["object_ids"]:
+                nodes = [self._nodes[n] for n in self._obj_locations.get(oid, ())
+                         if n in self._nodes and self._nodes[n].alive]
+                out[oid] = {
+                    "locations": [(n.node_id, n.address) for n in nodes],
+                    "size": self._obj_sizes.get(oid, 0),
+                    "failed": self._failed_objects.get(oid),
+                }
+            conn.reply(msg_id, out)
+
+    def _h_wait_for_objects(self, conn, p, msg_id):
+        """Park until num_returns of object_ids are ready (or failed/timeout)."""
+        with self._lock:
+            ids: List[bytes] = p["object_ids"]
+            ready = {o for o in ids if self._obj_locations.get(o)}
+            failed = {o for o in ids if o in self._failed_objects} - ready
+            need = p.get("num_returns", len(ids))
+            if len(ready) + len(failed) >= need:
+                conn.reply(msg_id, {
+                    "ready": list(ready),
+                    "failed": {o: self._failed_objects.get(o, "failed")
+                               for o in failed},
+                    "timeout": False,
+                })
+                return
+            timeout = p.get("timeout")
+            w = _ObjWaiter(
+                conn=conn, msg_id=msg_id,
+                pending=set(ids) - ready - failed,
+                num_needed=need, ready=ready, failed=failed,
+                deadline=(time.time() + timeout) if timeout is not None else None,
+            )
+            self._obj_waiters.append(w)
+
+    def _h_free_objects(self, conn, p, msg_id):
+        with self._lock:
+            ids = p["object_ids"]
+            by_node: Dict[str, List[bytes]] = collections.defaultdict(list)
+            for oid in ids:
+                for nid in self._obj_locations.pop(oid, ()):  # noqa: B909
+                    by_node[nid].append(oid)
+                self._obj_sizes.pop(oid, None)
+            for nid, oids in by_node.items():
+                node = self._nodes.get(nid)
+                if node is not None and node.alive:
+                    node.conn.notify("delete_objects", {"object_ids": oids})
+        conn.reply(msg_id, True)
+
+    # -------------------------------------------------------------- actors
+
+    def _h_create_actor(self, conn, spec: ActorCreationSpec, msg_id):
+        with self._lock:
+            if spec.name:
+                key = (spec.namespace, spec.name)
+                existing = self._named_actors.get(key)
+                if existing is not None and \
+                        self._actors[existing].state != DEAD:
+                    conn.reply_error(
+                        msg_id, f"actor name '{spec.name}' already taken")
+                    return
+                self._named_actors[key] = spec.actor_id.binary()
+            entry = ActorEntry(spec=spec, restarts_left=spec.max_restarts)
+            self._actors[spec.actor_id.binary()] = entry
+            if not self._schedule_actor(entry):
+                self._queued_tasks.append(_ActorCreationShim(entry))
+            conn.reply(msg_id, {"ok": True})
+
+    def _schedule_actor(self, entry: ActorEntry) -> bool:
+        """Try to place the actor now. Returns True if dispatched (or parked
+        on unready dependencies); False if it must wait for capacity."""
+        spec = entry.spec
+        if self._unready_deps(spec.arg_deps):
+            entry.state = DEPENDENCIES_UNREADY
+            # Park on the first unready dep; re-enqueued via _add_location.
+            d = self._unready_deps(spec.arg_deps)[0]
+            self._waiting_tasks[d.binary()].append(_ActorCreationShim(entry))
+            return True
+        if spec.placement_group_id is not None:
+            pg = self._pgs.get(spec.placement_group_id.binary())
+            node = None
+            if pg is not None and pg.state == "CREATED":
+                node = self._node_for_pg_task(spec)
+        else:
+            node = self._pick_node(spec.resources, spec.scheduling_strategy)
+        if node is None or not self._acquire_for(spec, node):
+            entry.state = PENDING_CREATION
+            entry.node_id = None
+            return False
+        entry.state = PENDING_CREATION
+        entry.node_id = node.node_id
+        node.conn.notify("create_actor", spec)
+        return True
+
+    def _h_actor_state(self, conn, p, msg_id):
+        """Node manager reports actor lifecycle transitions."""
+        with self._lock:
+            aid = p["actor_id"]
+            entry = self._actors.get(aid)
+            if entry is None:
+                return
+            state = p["state"]
+            if state == ALIVE:
+                entry.state = ALIVE
+                self._reply_actor_waiters(entry)
+            elif state == DEAD:
+                if p.get("creation_failed"):
+                    # __init__ raised: actor is permanently dead
+                    entry.state = DEAD
+                    entry.death_cause = p.get("error", "creation failed")
+                    if entry.node_id:
+                        self._release_for(entry.spec, entry.node_id)
+                    self._reply_actor_waiters(entry)
+                else:
+                    self._on_actor_down(aid, p.get("error", "actor exited"),
+                                        expected=p.get("expected", False))
+            self._try_schedule()
+
+    def _on_actor_down(self, aid: bytes, cause: str, expected: bool = False):
+        entry = self._actors.get(aid)
+        if entry is None or entry.state == DEAD:
+            return
+        if entry.node_id:
+            self._release_for(entry.spec, entry.node_id)
+            entry.node_id = None
+        if not expected and entry.restarts_left != 0:
+            if entry.restarts_left > 0:
+                entry.restarts_left -= 1
+            entry.num_restarts += 1
+            entry.state = RESTARTING
+            logger.info("restarting actor %s (%s)", entry.spec.class_name, cause)
+            if not self._schedule_actor(entry):
+                self._queued_tasks.append(_ActorCreationShim(entry))
+        else:
+            entry.state = DEAD
+            entry.death_cause = cause
+            self._reply_actor_waiters(entry)
+
+    def _reply_actor_waiters(self, entry: ActorEntry):
+        waiters, entry.waiters = entry.waiters, []
+        info = self._actor_info(entry)
+        for conn, msg_id in waiters:
+            try:
+                conn.reply(msg_id, info)
+            except Exception:
+                pass
+        # Flush (or fail) actor tasks parked while the actor was transitioning.
+        pending, entry.pending_tasks = entry.pending_tasks, []
+        if not pending:
+            return
+        if entry.state == ALIVE and entry.node_id in self._nodes:
+            node = self._nodes[entry.node_id]
+            for spec in pending:
+                try:
+                    node.conn.notify("submit_actor_task", spec)
+                except Exception:
+                    pass
+        else:
+            for spec in pending:
+                self._fail_task_objects(
+                    spec, entry.death_cause or "actor died")
+
+    def _h_reroute_actor_task(self, conn, spec: ActorTaskSpec, msg_id):
+        """An actor task arrived at a node no longer hosting the actor."""
+        with self._lock:
+            entry = self._actors.get(spec.actor_id.binary())
+            if entry is None or entry.state == DEAD:
+                cause = entry.death_cause if entry else "actor not found"
+                self._fail_task_objects(spec, cause or "actor died")
+            elif entry.state == ALIVE and entry.node_id in self._nodes:
+                self._nodes[entry.node_id].conn.notify(
+                    "submit_actor_task", spec)
+            else:
+                entry.pending_tasks.append(spec)
+
+    def _actor_info(self, entry: ActorEntry) -> dict:
+        node = self._nodes.get(entry.node_id) if entry.node_id else None
+        return {
+            "actor_id": entry.spec.actor_id,
+            "state": entry.state,
+            "node_id": entry.node_id,
+            "node_address": node.address if node else None,
+            "death_cause": entry.death_cause,
+            "num_restarts": entry.num_restarts,
+            "class_name": entry.spec.class_name,
+            "name": entry.spec.name,
+            "namespace": entry.spec.namespace,
+            "class_key": entry.spec.class_key,
+            "max_task_retries": entry.spec.max_task_retries,
+            "is_async": entry.spec.is_async,
+            "max_concurrency": entry.spec.max_concurrency,
+        }
+
+    def _h_resolve_actor(self, conn, p, msg_id):
+        """Reply with the actor's location; parks while PENDING/RESTARTING."""
+        with self._lock:
+            entry = self._actors.get(p["actor_id"])
+            if entry is None:
+                conn.reply_error(msg_id, "actor not found")
+                return
+            if entry.state in (ALIVE, DEAD):
+                conn.reply(msg_id, self._actor_info(entry))
+            else:
+                entry.waiters.append((conn, msg_id))
+
+    def _h_get_actor_by_name(self, conn, p, msg_id):
+        with self._lock:
+            aid = self._named_actors.get((p.get("namespace", "default"),
+                                          p["name"]))
+            entry = self._actors.get(aid) if aid else None
+            if entry is None or entry.state == DEAD:
+                conn.reply(msg_id, None)
+            else:
+                conn.reply(msg_id, self._actor_info(entry))
+
+    def _h_list_named_actors(self, conn, p, msg_id):
+        with self._lock:
+            out = []
+            for (ns, name), aid in self._named_actors.items():
+                e = self._actors.get(aid)
+                if e is not None and e.state != DEAD:
+                    if p.get("all_namespaces") or ns == p.get("namespace",
+                                                             "default"):
+                        out.append({"name": name, "namespace": ns})
+            conn.reply(msg_id, out)
+
+    def _h_kill_actor(self, conn, p, msg_id):
+        with self._lock:
+            self._kill_actor_locked(p["actor_id"], p.get("no_restart", True),
+                                    "ray.kill")
+        conn.reply(msg_id, True)
+
+    def _kill_actor_locked(self, aid: bytes, no_restart: bool, cause: str):
+        entry = self._actors.get(aid)
+        if entry is None or entry.state == DEAD:
+            return
+        if no_restart:
+            entry.restarts_left = 0
+        node = self._nodes.get(entry.node_id) if entry.node_id else None
+        if node is not None and node.alive:
+            node.conn.notify("kill_actor", {"actor_id": aid,
+                                            "no_restart": no_restart})
+        else:
+            self._on_actor_down(aid, cause, expected=no_restart)
+
+    def _h_list_actors(self, conn, p, msg_id):
+        with self._lock:
+            conn.reply(msg_id, [self._actor_info(e)
+                                for e in self._actors.values()])
+
+    # ----------------------------------------------------- placement groups
+
+    def _h_create_pg(self, conn, spec: PlacementGroupSpec, msg_id):
+        with self._lock:
+            if spec.name:
+                if spec.name in self._named_pgs:
+                    conn.reply_error(msg_id,
+                                     f"placement group '{spec.name}' exists")
+                    return
+                self._named_pgs[spec.name] = spec.pg_id.binary()
+            entry = PgEntry(spec=spec)
+            self._pgs[spec.pg_id.binary()] = entry
+            self._try_place_pg(entry)
+            conn.reply(msg_id, {"ok": True})
+
+    def _try_place_pg(self, entry: PgEntry) -> bool:
+        """Bundle placement (reference:
+        raylet/scheduling/policy/bundle_scheduling_policy.h:31). All-or-
+        nothing: trial-reserve, commit on success."""
+        spec = entry.spec
+        alive = [n for n in self._nodes.values() if n.alive]
+        if not alive:
+            return False
+        # Work on copies of availability for atomicity.
+        avail = {n.node_id: ResourceSet(n.available.to_dict()) for n in alive}
+        placement: Dict[int, str] = {}
+        strategy = spec.strategy
+
+        def nodes_sorted():
+            return sorted(alive, key=lambda n: avail[n.node_id].utilization(
+                n.total))
+
+        ok = True
+        if strategy in ("PACK", "STRICT_PACK"):
+            order = sorted(alive, key=lambda n: avail[n.node_id].utilization(
+                n.total))
+            if strategy == "STRICT_PACK":
+                # all bundles on ONE node
+                ok = False
+                for n in order:
+                    a = ResourceSet(avail[n.node_id].to_dict())
+                    if all(a.acquire(b.resources) for b in spec.bundles):
+                        for b in spec.bundles:
+                            placement[b.index] = n.node_id
+                        avail[n.node_id] = a
+                        ok = True
+                        break
+            else:
+                for b in spec.bundles:
+                    placed = False
+                    for n in order:
+                        if avail[n.node_id].acquire(b.resources):
+                            placement[b.index] = n.node_id
+                            placed = True
+                            break
+                    if not placed:
+                        ok = False
+                        break
+        elif strategy in ("SPREAD", "STRICT_SPREAD"):
+            used_nodes: Set[str] = set()
+            for b in spec.bundles:
+                cands = nodes_sorted()
+                placed = False
+                for n in cands:
+                    if strategy == "STRICT_SPREAD" and n.node_id in used_nodes:
+                        continue
+                    if avail[n.node_id].acquire(b.resources):
+                        placement[b.index] = n.node_id
+                        used_nodes.add(n.node_id)
+                        placed = True
+                        break
+                if not placed:
+                    ok = False
+                    break
+        else:
+            ok = False
+        if not ok:
+            return False
+        # Commit.
+        for b in spec.bundles:
+            nid = placement[b.index]
+            b.node_id = nid
+            self._nodes[nid].available.acquire(b.resources)
+            entry.bundle_available[b.index] = ResourceSet(b.resources)
+        entry.state = "CREATED"
+        waiters, entry.waiters = entry.waiters, []
+        for conn, msg_id in waiters:
+            try:
+                conn.reply(msg_id, {"state": "CREATED"})
+            except Exception:
+                pass
+        self._try_schedule()
+        return True
+
+    def _try_schedule_pgs(self):
+        for entry in self._pgs.values():
+            if entry.state == "PENDING":
+                self._try_place_pg(entry)
+
+    def _h_wait_pg_ready(self, conn, p, msg_id):
+        with self._lock:
+            entry = self._pgs.get(p["pg_id"])
+            if entry is None:
+                conn.reply_error(msg_id, "placement group not found")
+            elif entry.state == "CREATED":
+                conn.reply(msg_id, {"state": "CREATED"})
+            else:
+                entry.waiters.append((conn, msg_id))
+
+    def _h_remove_pg(self, conn, p, msg_id):
+        with self._lock:
+            entry = self._pgs.get(p["pg_id"])
+            if entry is not None and entry.state == "CREATED":
+                # return bundle capacity to nodes
+                for b in entry.spec.bundles:
+                    node = self._nodes.get(b.node_id)
+                    if node is not None:
+                        # release only the unused part plus used part: the
+                        # whole bundle reservation goes back
+                        node.available.release(b.resources)
+                entry.state = "REMOVED"
+                if entry.spec.name:
+                    self._named_pgs.pop(entry.spec.name, None)
+            self._try_schedule()
+        conn.reply(msg_id, True)
+
+    def _h_pg_table(self, conn, p, msg_id):
+        with self._lock:
+            out = {}
+            for pid, e in self._pgs.items():
+                out[pid] = {
+                    "name": e.spec.name,
+                    "strategy": e.spec.strategy,
+                    "state": e.state,
+                    "bundles": [
+                        {"index": b.index, "resources": b.resources,
+                         "node_id": b.node_id} for b in e.spec.bundles],
+                }
+            conn.reply(msg_id, out)
+
+    # ------------------------------------------------------- task events
+
+    def _h_task_events(self, conn, p, msg_id):
+        with self._lock:
+            self._task_events.extend(p)
+
+    def _h_get_timeline(self, conn, p, msg_id):
+        with self._lock:
+            conn.reply(msg_id, list(self._task_events))
+
+    # ------------------------------------------------------------ shutdown
+
+    def _h_shutdown_cluster(self, conn, p, msg_id):
+        conn.reply(msg_id, True)
+        threading.Thread(target=self.close, daemon=True).start()
+
+
+class _SpreadShim:
+    kind = "spread"
+
+
+class _ActorCreationShim:
+    """Lets pending actor creations ride the task queue/dep machinery."""
+
+    __slots__ = ("actor_id", "task_id", "arg_deps", "placement_group_id")
+
+    def __init__(self, entry: ActorEntry):
+        self.actor_id = entry.spec.actor_id
+        self.task_id = TaskID.for_actor_creation(entry.spec.actor_id)
+        self.arg_deps = entry.spec.arg_deps
+        self.placement_group_id = None
+
+
+def p_kind(spec) -> str:
+    return "actor" if isinstance(spec, (ActorCreationSpec, ActorTaskSpec)) \
+        else "task"

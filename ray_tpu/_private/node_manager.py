@@ -1,0 +1,594 @@
+"""Node manager: the per-node daemon.
+
+Role-equivalent to the reference's raylet
+(reference: src/ray/raylet/node_manager.h:115): owns the node's shared-memory
+object store, manages the worker pool (reference: raylet/worker_pool.h:156),
+executes task leases granted by the GCS scheduler, serves cross-node object
+pulls (reference: src/ray/object_manager/object_manager.h:117), and
+supervises actor workers.
+
+TPU-first deltas vs the reference raylet:
+- TPU chips are first-class schedulable resources; the node manager owns the
+  chip-id free list and exports ``TPU_VISIBLE_CHIPS`` / JAX platform env to
+  workers it spawns for TPU tasks (the analog of the reference's
+  CUDA_VISIBLE_DEVICES assignment, python/ray/_private/worker.py:855-878 —
+  but assigned at spawn time because an XLA client binds devices at init).
+- TPU tasks and actors always get freshly spawned workers so the XLA client
+  in each worker sees exactly its assigned chips.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+from ray_tpu import exceptions
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.task_spec import (
+    TPU,
+    ActorCreationSpec,
+    ActorTaskSpec,
+    TaskSpec,
+)
+from ray_tpu.object_store import plasma
+
+logger = logging.getLogger("ray_tpu.node")
+
+IDLE = "idle"
+BUSY = "busy"
+STARTING = "starting"
+ACTOR = "actor"
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: bytes
+    proc: subprocess.Popen
+    state: str = STARTING
+    conn: Optional[protocol.Conn] = None
+    current_tasks: Dict[bytes, Any] = field(default_factory=dict)
+    actor_id: Optional[bytes] = None
+    actor_spec: Optional[ActorCreationSpec] = None
+    tpu_chips: List[int] = field(default_factory=list)
+    dedicated: bool = False        # not returned to the pool
+    pending_pushes: List[tuple] = field(default_factory=list)
+    killed_by_us: bool = False
+    no_restart_kill: bool = False
+
+
+class NodeManager:
+    """One per node; embeddable in the head process or standalone."""
+
+    def __init__(
+        self,
+        gcs_address: str,
+        session_dir: str,
+        num_cpus: float,
+        num_tpus: float = 0,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: int = 1 << 30,
+        is_head: bool = False,
+        node_name: str = "node",
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.node_id = NodeID.from_random().hex()
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        os.makedirs(session_dir, exist_ok=True)
+        self.store_path = os.path.join(
+            session_dir, f"store_{self.node_id[:12]}")
+        plasma.create_store(self.store_path, object_store_memory)
+        self.store = plasma.PlasmaClient(self.store_path)
+
+        self._lock = threading.RLock()
+        self._workers: Dict[bytes, WorkerHandle] = {}
+        self._actors: Dict[bytes, WorkerHandle] = {}      # actor_id -> worker
+        self._idle: List[WorkerHandle] = []
+        self._task_queue: List[TaskSpec] = []
+        self._num_cpus = num_cpus
+        self._max_pool = max(1, int(num_cpus))
+        self._free_tpu_chips: Set[int] = set(range(int(num_tpus)))
+        self._shutdown = False
+
+        total = dict(resources or {})
+        total.setdefault("CPU", float(num_cpus))
+        if num_tpus:
+            total.setdefault("TPU", float(num_tpus))
+        total.setdefault("node:" + self.node_id[:12], 1.0)
+        self._total_resources = total
+
+        # Server for workers, remote pullers, and actor-task callers.
+        self.server = protocol.Server(self._handle_server, name=f"nm-{node_name}")
+        self.server.on_disconnect = self._on_server_disconnect
+        self.address = self.server.address
+
+        # Client connection to the GCS.
+        self.gcs = protocol.connect(gcs_address, handler=self._handle_gcs,
+                                    name=f"nm-gcs-{node_name}")
+        self.gcs.request("register_node", {
+            "node_id": self.node_id,
+            "address": self.address,
+            "store_path": self.store_path,
+            "resources": total,
+            "labels": labels or {},
+            "is_head": is_head,
+        })
+        # Prestart the pool (reference: worker_pool.h:245 PrestartWorkers).
+        for _ in range(self._max_pool):
+            self._spawn_worker()
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
+                                        name="rtpu-nm-reaper")
+        self._reaper.start()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def shutdown(self):
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            workers = list(self._workers.values())
+        for w in workers:
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+        for w in workers:
+            try:
+                w.proc.wait(timeout=5)
+            except Exception:
+                pass
+        self.server.close()
+        try:
+            self.gcs.close()
+        except Exception:
+            pass
+        try:
+            self.store.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(self.store_path)
+        except OSError:
+            pass
+
+    def _reap_loop(self):
+        """Detect dead worker processes even if their socket lingers."""
+        while not self._shutdown:
+            time.sleep(0.2)
+            with self._lock:
+                dead = [w for w in self._workers.values()
+                        if w.proc.poll() is not None and w.state != "dead"]
+            for w in dead:
+                self._on_worker_death(w)
+
+    # ---------------------------------------------------------- worker pool
+
+    def _spawn_worker(self, dedicated: bool = False,
+                      env_extra: Optional[Dict[str, str]] = None,
+                      tpu_chips: Optional[List[int]] = None) -> WorkerHandle:
+        worker_id = WorkerID.from_random().binary()
+        env = dict(os.environ)
+        if not tpu_chips:
+            # CPU-only worker: skip the TPU PJRT plugin preimport at python
+            # startup (the analog of hiding GPUs via CUDA_VISIBLE_DEVICES=""
+            # in the reference). TPU tasks/actors always get freshly spawned
+            # workers with the full TPU environment.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(env_extra or {})
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_NM_ADDRESS"] = self.address
+        env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
+        env["RAY_TPU_STORE_PATH"] = self.store_path
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if tpu_chips:
+            # Restrict the worker's XLA client to its assigned chips.
+            env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
+            env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,{len(tpu_chips)},1"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env,
+            cwd=os.getcwd(),
+        )
+        handle = WorkerHandle(worker_id=worker_id, proc=proc,
+                              dedicated=dedicated, tpu_chips=tpu_chips or [])
+        with self._lock:
+            self._workers[worker_id] = handle
+        return handle
+
+    def _on_server_disconnect(self, conn: protocol.Conn):
+        wid = conn.meta.get("worker_id")
+        if wid is None:
+            return
+        with self._lock:
+            w = self._workers.get(wid)
+        if w is not None and w.state != "dead":
+            self._on_worker_death(w)
+
+    def _on_worker_death(self, w: WorkerHandle):
+        with self._lock:
+            if w.state == "dead":
+                return
+            prev_state = w.state
+            w.state = "dead"
+            self._workers.pop(w.worker_id, None)
+            if w in self._idle:
+                self._idle.remove(w)
+            for chip in w.tpu_chips:
+                self._free_tpu_chips.add(chip)
+            tasks = dict(w.current_tasks)
+            w.current_tasks.clear()
+            actor_id = w.actor_id
+        # Fail in-flight tasks: write error objects, report crashed.
+        for tid, spec in tasks.items():
+            if isinstance(spec, (TaskSpec, ActorTaskSpec)):
+                err = exceptions.WorkerCrashedError(
+                    f"worker running {getattr(spec, 'name', '')} died "
+                    f"(exit code {w.proc.poll()})")
+                if isinstance(spec, ActorTaskSpec):
+                    err = exceptions.RayActorError(
+                        actor_id=spec.actor_id.hex(), msg="actor died")
+                objs = self._store_errors([r.binary() for r in
+                                           spec.return_ids()], err)
+                self._report_task_done(tid, "crashed", objs,
+                                       error=str(err))
+        if actor_id is not None:
+            with self._lock:
+                self._actors.pop(actor_id, None)
+            try:
+                self.gcs.notify("actor_state", {
+                    "actor_id": actor_id,
+                    "state": "DEAD",
+                    "expected": w.no_restart_kill,
+                    "error": "actor worker died"
+                    if not w.killed_by_us else "actor killed",
+                })
+            except Exception:
+                pass
+        elif prev_state in (BUSY, IDLE, STARTING) and not self._shutdown \
+                and not w.dedicated:
+            # keep the pool full
+            with self._lock:
+                n = len([x for x in self._workers.values()
+                         if not x.dedicated])
+                if n < self._max_pool:
+                    self._spawn_worker()
+        self._dispatch_queued()
+
+    def _store_errors(self, object_ids: List[bytes], err: BaseException):
+        """Materialize an exception as the value of each object id."""
+        out = []
+        blob = serialization.serialize(err)
+        for oid in object_ids:
+            try:
+                self.store.put_serialized(oid, blob)
+            except plasma.ObjectExistsError:
+                pass
+            except Exception:
+                logger.exception("failed storing error object")
+                continue
+            out.append((oid, blob.total_size()))
+        if out:
+            try:
+                self.gcs.notify("add_object_locations", {
+                    "node_id": self.node_id, "objects": out})
+            except Exception:
+                pass
+        return out
+
+    def _report_task_done(self, task_id: bytes, status: str, objects,
+                          error: Optional[str] = None):
+        try:
+            self.gcs.notify("task_done", {
+                "task_id": task_id,
+                "status": status,
+                "objects": objects or [],
+                "node_id": self.node_id,
+                "error": error,
+            })
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- GCS messages
+
+    def _handle_gcs(self, conn, mtype, payload, msg_id):
+        try:
+            if mtype == "lease_task":
+                self._on_lease_task(payload)
+            elif mtype == "create_actor":
+                self._on_create_actor(payload)
+            elif mtype == "kill_actor":
+                self._on_kill_actor(payload)
+            elif mtype == "cancel_task":
+                self._on_cancel_task(payload)
+            elif mtype == "store_error_objects":
+                self._on_store_error_objects(payload)
+            elif mtype == "delete_objects":
+                for oid in payload["object_ids"]:
+                    self.store.delete(oid)
+            elif mtype == "submit_actor_task":
+                self._on_submit_actor_task(payload)
+            elif mtype == "shutdown":
+                threading.Thread(target=self.shutdown, daemon=True).start()
+        except Exception:
+            logger.exception("node manager: error handling %s", mtype)
+
+    def _on_store_error_objects(self, p):
+        kind = p.get("kind", "task")
+        if kind == "actor":
+            err: BaseException = exceptions.RayActorError(msg=p["error"])
+        elif p["error"] == "cancelled":
+            err = exceptions.TaskCancelledError()
+        else:
+            err = exceptions.RayTaskError(p.get("name", ""), p["error"])
+        self._store_errors(p["object_ids"], err)
+
+    def _on_lease_task(self, spec: TaskSpec):
+        needs_tpu = spec.resources.get(TPU, 0) > 0
+        with self._lock:
+            if needs_tpu:
+                k = int(spec.resources[TPU])
+                chips = sorted(self._free_tpu_chips)[:k]
+                if len(chips) < k:
+                    # Shouldn't happen (GCS accounts TPU), but be safe.
+                    self._task_queue.append(spec)
+                    return
+                for c in chips:
+                    self._free_tpu_chips.discard(c)
+                w = None
+            else:
+                chips = []
+                w = self._pop_idle_locked()
+            if w is None and not needs_tpu:
+                n = len([x for x in self._workers.values() if not x.dedicated])
+                if n < self._max_pool + 2:
+                    self._spawn_worker()
+                self._task_queue.append(spec)
+                return
+        if needs_tpu:
+            env = dict((spec.runtime_env or {}).get("env_vars", {}))
+            w = self._spawn_worker(dedicated=True, env_extra=env,
+                                   tpu_chips=chips)
+            with self._lock:
+                w.pending_pushes.append(("run_task", spec))
+                w.current_tasks[spec.task_id.binary()] = spec
+            return
+        self._push_task(w, spec)
+
+    def _pop_idle_locked(self) -> Optional[WorkerHandle]:
+        while self._idle:
+            w = self._idle.pop()
+            if w.state == IDLE and w.conn is not None and not w.conn.closed:
+                return w
+        return None
+
+    def _push_task(self, w: WorkerHandle, spec: TaskSpec):
+        with self._lock:
+            w.state = BUSY
+            w.current_tasks[spec.task_id.binary()] = spec
+            if w.conn is None:
+                w.pending_pushes.append(("run_task", spec))
+                return
+            conn = w.conn
+        try:
+            conn.notify("run_task", spec)
+        except protocol.ConnectionClosed:
+            self._on_worker_death(w)
+
+    def _dispatch_queued(self):
+        while True:
+            with self._lock:
+                if not self._task_queue:
+                    return
+                w = self._pop_idle_locked()
+                if w is None:
+                    return
+                spec = self._task_queue.pop(0)
+            self._push_task(w, spec)
+
+    def _on_create_actor(self, spec: ActorCreationSpec):
+        env = dict((spec.runtime_env or {}).get("env_vars", {}))
+        chips: List[int] = []
+        k = int(spec.resources.get(TPU, 0))
+        if k > 0:
+            with self._lock:
+                free = sorted(self._free_tpu_chips)[:k]
+                if len(free) < k:
+                    # report failure back; GCS will keep it pending
+                    self.gcs.notify("actor_state", {
+                        "actor_id": spec.actor_id.binary(), "state": "DEAD",
+                        "creation_failed": True,
+                        "error": "TPU chips unavailable"})
+                    return
+                for c in free:
+                    self._free_tpu_chips.discard(c)
+                chips = free
+        w = self._spawn_worker(dedicated=True, env_extra=env, tpu_chips=chips)
+        with self._lock:
+            w.state = ACTOR
+            w.actor_id = spec.actor_id.binary()
+            w.actor_spec = spec
+            self._actors[spec.actor_id.binary()] = w
+            w.pending_pushes.append(("create_actor", spec))
+
+    def _on_kill_actor(self, p):
+        with self._lock:
+            w = self._actors.get(p["actor_id"])
+            if w is None:
+                return
+            w.killed_by_us = True
+            w.no_restart_kill = p.get("no_restart", True)
+        try:
+            w.proc.kill()
+        except Exception:
+            pass
+
+    def _on_cancel_task(self, p):
+        tid = p["task_id"]
+        with self._lock:
+            target = None
+            for w in self._workers.values():
+                if tid in w.current_tasks:
+                    target = w
+                    break
+            # also drop from the local queue, failing the dropped task's
+            # returns so getters see TaskCancelledError
+            dropped = [s for s in self._task_queue
+                       if s.task_id.binary() == tid]
+            self._task_queue = [s for s in self._task_queue
+                                if s.task_id.binary() != tid]
+        for s in dropped:
+            objs = self._store_errors(
+                [r.binary() for r in s.return_ids()],
+                exceptions.TaskCancelledError(tid.hex()))
+            self._report_task_done(tid, "error", objs, error="cancelled")
+        if target is None:
+            return
+        if p.get("force"):
+            try:
+                target.proc.kill()
+            except Exception:
+                pass
+        elif target.conn is not None:
+            try:
+                target.conn.notify("cancel_task", {"task_id": tid})
+            except protocol.ConnectionClosed:
+                pass
+
+    def _on_submit_actor_task(self, spec: ActorTaskSpec):
+        aid = spec.actor_id.binary()
+        with self._lock:
+            w = self._actors.get(aid)
+            if w is not None and w.state != "dead":
+                w.current_tasks[spec.task_id.binary()] = spec
+                if w.conn is None:
+                    w.pending_pushes.append(("run_actor_task", spec))
+                    return
+                conn = w.conn
+            else:
+                conn = None
+        if conn is not None:
+            try:
+                conn.notify("run_actor_task", spec)
+                return
+            except protocol.ConnectionClosed:
+                self._on_worker_death(w)
+                return
+        # Not hosted here (moved or dead): ask GCS to reroute.
+        try:
+            self.gcs.notify("reroute_actor_task", spec)
+        except Exception:
+            pass
+
+    # ----------------------------------------------------- server messages
+
+    def _handle_server(self, conn, mtype, payload, msg_id):
+        try:
+            if mtype == "register_worker":
+                self._on_register_worker(conn, payload, msg_id)
+            elif mtype == "task_done":
+                self._on_task_done(conn, payload)
+            elif mtype == "actor_ready":
+                self.gcs.notify("actor_state", {
+                    "actor_id": payload["actor_id"], "state": "ALIVE"})
+            elif mtype == "actor_failed":
+                self.gcs.notify("actor_state", {
+                    "actor_id": payload["actor_id"], "state": "DEAD",
+                    "creation_failed": True, "error": payload.get("error")})
+                with self._lock:
+                    w = self._actors.pop(payload["actor_id"], None)
+                    if w is not None:
+                        w.actor_id = None  # plain dead worker now
+            elif mtype == "actor_exit":
+                with self._lock:
+                    w = self._actors.get(payload["actor_id"])
+                    if w is not None:
+                        w.killed_by_us = True
+                        w.no_restart_kill = True
+            elif mtype == "submit_actor_task":
+                self._on_submit_actor_task(payload)
+            elif mtype == "fetch_object":
+                self._on_fetch_object(conn, payload, msg_id)
+            elif mtype == "store_stats":
+                conn.reply(msg_id, self.store.stats())
+            else:
+                conn.reply_error(msg_id, f"nm: unknown message {mtype}")
+        except Exception as e:
+            logger.exception("node manager server: error handling %s", mtype)
+            try:
+                conn.reply_error(msg_id, f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+
+    def _on_register_worker(self, conn, p, msg_id):
+        wid = p["worker_id"]
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None:
+                conn.reply_error(msg_id, "unknown worker")
+                return
+            w.conn = conn
+            conn.meta["worker_id"] = wid
+            pushes, w.pending_pushes = w.pending_pushes, []
+            if w.state == STARTING:
+                if w.dedicated:
+                    w.state = BUSY
+                else:
+                    w.state = IDLE
+                    self._idle.append(w)
+        conn.reply(msg_id, {"node_id": self.node_id})
+        for mtype, payload in pushes:
+            try:
+                conn.notify(mtype, payload)
+            except protocol.ConnectionClosed:
+                self._on_worker_death(w)
+                return
+        self._dispatch_queued()
+
+    def _on_task_done(self, conn, p):
+        wid = conn.meta.get("worker_id")
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None:
+                return
+            spec = w.current_tasks.pop(p["task_id"], None)
+            release_worker = (w.state == BUSY and not w.current_tasks)
+            if release_worker and not w.dedicated:
+                w.state = IDLE
+                self._idle.append(w)
+            if release_worker and w.dedicated and w.actor_id is None:
+                # one-shot TPU worker: retire it
+                for chip in w.tpu_chips:
+                    self._free_tpu_chips.add(chip)
+                w.tpu_chips = []
+                try:
+                    conn.notify("exit")
+                except protocol.ConnectionClosed:
+                    pass
+        self._report_task_done(p["task_id"], p["status"], p.get("objects"),
+                               error=p.get("error"))
+        self._dispatch_queued()
+
+    def _on_fetch_object(self, conn, p, msg_id):
+        """Serve a cross-node object pull (reference: object_manager Push,
+        protobuf/object_manager.proto:63; chunking elided — one framed blob)."""
+        view = self.store.get_buffer(p["object_id"], timeout_ms=p.get(
+            "timeout_ms", 5000))
+        if view is None:
+            conn.reply(msg_id, None)
+            return
+        try:
+            data = bytes(view)
+        finally:
+            del view
+            self.store.release(p["object_id"])
+        conn.reply(msg_id, data)

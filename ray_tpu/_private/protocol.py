@@ -1,0 +1,294 @@
+"""Length-framed socket RPC used by the control plane.
+
+Role-equivalent to the reference's gRPC plumbing (reference: src/ray/rpc/
+grpc_server.h:73, client_call.h:181): every control-plane process (GCS, node
+manager, worker, driver) exchanges framed messages over TCP / Unix sockets.
+A message is ``(msg_id, reply_to, mtype, payload, is_error)``; replies are
+matched to outstanding request futures, everything else is handed to the
+connection's handler.
+
+The data plane (tensors) does NOT flow through here in the common case — it
+lives in the shared-memory object store; this channel carries task specs,
+scheduling decisions, and small control payloads (plus cross-node object
+chunks, the analog of the reference's object-manager Push RPC).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct("<Q")
+_MAX_FRAME = 1 << 34  # 16 GiB sanity bound
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+class RemoteCallError(Exception):
+    """The peer's handler raised; message carries the remote traceback."""
+
+
+def _recv_exact(sock: socket.socket, n: int, into: Optional[memoryview] = None):
+    buf = into if into is not None else memoryview(bytearray(n))
+    got = 0
+    while got < n:
+        try:
+            k = sock.recv_into(buf[got:], n - got)
+        except (ConnectionResetError, OSError):
+            raise ConnectionClosed()
+        if k == 0:
+            raise ConnectionClosed()
+        got += k
+    return buf
+
+
+class Conn:
+    """One bidirectional connection with request/reply multiplexing."""
+
+    def __init__(self, sock: socket.socket, handler=None, name: str = ""):
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._handler = handler
+        self._pending: Dict[int, "_Future"] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id_lock = threading.Lock()
+        self._next_id = 1
+        self._closed = False
+        self.name = name
+        self.on_close: Optional[Callable[["Conn"], None]] = None
+        # peer-assigned metadata, used by servers to track who this is
+        self.meta: Dict[str, Any] = {}
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # unix sockets
+
+    # -- sending --------------------------------------------------------------
+
+    def _alloc_id(self) -> int:
+        with self._next_id_lock:
+            i = self._next_id
+            self._next_id += 1
+            return i
+
+    def _send(self, msg_id, reply_to, mtype, payload, is_error=False):
+        data = pickle.dumps((msg_id, reply_to, mtype, payload, is_error),
+                            protocol=5)
+        frame = _LEN.pack(len(data)) + data
+        with self._send_lock:
+            if self._closed:
+                raise ConnectionClosed()
+            try:
+                self._sock.sendall(frame)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                raise ConnectionClosed()
+
+    def notify(self, mtype: str, payload: Any = None) -> None:
+        """Fire-and-forget message."""
+        self._send(self._alloc_id(), None, mtype, payload)
+
+    def request_nowait(self, mtype: str, payload: Any = None) -> "_Future":
+        fut = _Future()
+        msg_id = self._alloc_id()
+        with self._pending_lock:
+            self._pending[msg_id] = fut
+        try:
+            self._send(msg_id, None, mtype, payload)
+        except BaseException:
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            raise
+        return fut
+
+    def request(self, mtype: str, payload: Any = None,
+                timeout: Optional[float] = None) -> Any:
+        return self.request_nowait(mtype, payload).result(timeout)
+
+    def reply(self, to_msg_id: int, payload: Any = None) -> None:
+        self._send(self._alloc_id(), to_msg_id, "reply", payload)
+
+    def reply_error(self, to_msg_id: int, err: str) -> None:
+        self._send(self._alloc_id(), to_msg_id, "reply", err, is_error=True)
+
+    # -- receiving ------------------------------------------------------------
+
+    def serve(self) -> None:
+        """Blocking receive loop (run in a dedicated thread)."""
+        try:
+            hdr = bytearray(_LEN.size)
+            while not self._closed:
+                _recv_exact(self._sock, _LEN.size, memoryview(hdr))
+                (length,) = _LEN.unpack(hdr)
+                if length > _MAX_FRAME:
+                    raise ConnectionClosed()
+                body = _recv_exact(self._sock, length)
+                msg_id, reply_to, mtype, payload, is_error = pickle.loads(body)
+                if reply_to is not None:
+                    with self._pending_lock:
+                        fut = self._pending.pop(reply_to, None)
+                    if fut is not None:
+                        if is_error:
+                            fut.set_error(RemoteCallError(payload))
+                        else:
+                            fut.set(payload)
+                elif self._handler is not None:
+                    self._handler(self, mtype, payload, msg_id)
+        except ConnectionClosed:
+            pass
+        except Exception:
+            pass
+        finally:
+            self.close()
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve, daemon=True,
+                             name=f"rtpu-conn-{self.name}")
+        t.start()
+        return t
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for fut in pending:
+            fut.set_error(ConnectionClosed())
+        cb, self.on_close = self.on_close, None
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class _Future:
+    __slots__ = ("_ev", "_value", "_error")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._error = None
+
+    def set(self, value):
+        self._value = value
+        self._ev.set()
+
+    def set_error(self, err):
+        self._error = err
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class Server:
+    """Accepts connections and runs a receive loop per client."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0,
+                 unix_path: Optional[str] = None, name: str = ""):
+        self._handler = handler
+        self.name = name
+        self.on_disconnect: Optional[Callable[[Conn], None]] = None
+        if unix_path is not None:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                os.unlink(unix_path)
+            except FileNotFoundError:
+                pass
+            self._sock.bind(unix_path)
+            self.address = unix_path
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self.address = "%s:%d" % self._sock.getsockname()[:2]
+        self._sock.listen(512)
+        self._conns: list = []
+        self._closed = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                        name=f"rtpu-accept-{name}")
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                client, _ = self._sock.accept()
+            except OSError:
+                break
+            conn = Conn(client, self._handler, name=self.name)
+            conn.on_close = self._on_conn_close
+            self._conns.append(conn)
+            conn.start()
+
+    def _on_conn_close(self, conn: Conn):
+        try:
+            self._conns.remove(conn)
+        except ValueError:
+            pass
+        if self.on_disconnect is not None and not self._closed:
+            try:
+                self.on_disconnect(conn)
+            except Exception:
+                pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for conn in list(self._conns):
+            conn.close()
+
+
+def connect(address: str, handler=None, name: str = "",
+            timeout: float = 30.0) -> Conn:
+    """Connect to ``host:port`` or a unix-socket path; starts the recv loop."""
+    deadline = time.monotonic() + timeout
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            if ":" in address:
+                host, port = address.rsplit(":", 1)
+                sock = socket.create_connection((host, int(port)), timeout=5)
+                sock.settimeout(None)
+            else:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.connect(address)
+            conn = Conn(sock, handler, name=name)
+            conn.start()
+            return conn
+        except (ConnectionRefusedError, FileNotFoundError, socket.timeout,
+                OSError) as e:
+            last_err = e
+            time.sleep(0.05)
+    raise ConnectionError(f"could not connect to {address}: {last_err}")

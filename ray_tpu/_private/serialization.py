@@ -14,8 +14,6 @@ Wire layout of a stored object (64-byte aligned buffers for zero-copy numpy):
 
 from __future__ import annotations
 
-import contextvars
-import io
 import pickle
 import struct
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -26,31 +24,6 @@ _MAGIC = 0x52545055  # "RTPU"
 _ALIGN = 64
 _HEADER = struct.Struct("<IIQ")
 _BUF_DESC = struct.Struct("<QQ")
-
-# Collects ObjectRefs encountered while pickling a value, so task specs can
-# record nested-ref dependencies (reference: serialization.py tracks contained
-# object refs for ownership/borrowing).
-_ref_collector: contextvars.ContextVar[Optional[list]] = contextvars.ContextVar(
-    "ray_tpu_ref_collector", default=None
-)
-
-
-def collect_nested_refs() -> contextvars.Token:
-    return _ref_collector.set([])
-
-
-def finish_collect(token: contextvars.Token) -> list:
-    refs = _ref_collector.get() or []
-    _ref_collector.reset(token)
-    return refs
-
-
-def note_object_ref(ref) -> None:
-    """Called from ObjectRef.__reduce__ while a collector is active."""
-    refs = _ref_collector.get()
-    if refs is not None:
-        refs.append(ref)
-
 
 class SerializedObject:
     __slots__ = ("metadata", "buffers")
@@ -89,12 +62,6 @@ class SerializedObject:
 
 def _aligned(offset: int) -> int:
     return (offset + _ALIGN - 1) & ~(_ALIGN - 1)
-
-
-def _to_host(obj: Any) -> Any:
-    """Move jax arrays to host numpy before pickling (device buffers do not
-    survive a process hop; the receiving worker re-commits to its devices)."""
-    return obj
 
 
 def serialize(value: Any) -> SerializedObject:

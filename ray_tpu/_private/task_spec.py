@@ -1,0 +1,188 @@
+"""Task / actor specifications and resource sets.
+
+Role-equivalent to the reference's ``TaskSpecification``
+(reference: src/ray/common/task/task_spec.h) and the option schema in
+``python/ray/_private/ray_option_utils.py``. Specs are plain picklable
+dataclasses; the function/class payloads are cloudpickled once and cached in
+the GCS function store (reference: python/ray/_private/function_manager.py:181)
+so repeat submissions ship only the function key.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+# Resource names. TPU is first-class (the reference only knows NVIDIA GPUs:
+# python/ray/util/accelerators/accelerators.py:1-7).
+CPU = "CPU"
+TPU = "TPU"
+GPU = "GPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+
+def normalize_resources(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    num_gpus: Optional[float] = None,
+    memory: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    default_cpus: float = 1.0,
+) -> Dict[str, float]:
+    """Merge the convenience kwargs into one resource dict."""
+    out: Dict[str, float] = {}
+    out[CPU] = float(num_cpus) if num_cpus is not None else default_cpus
+    if num_tpus:
+        out[TPU] = float(num_tpus)
+    if num_gpus:
+        out[GPU] = float(num_gpus)
+    if memory:
+        out[MEMORY] = float(memory)
+    for k, v in (resources or {}).items():
+        if k in (CPU, TPU, GPU):
+            raise ValueError(
+                f"Use num_cpus/num_tpus/num_gpus instead of resources[{k!r}]")
+        out[k] = float(v)
+    return {k: v for k, v in out.items() if v != 0 or k == CPU}
+
+
+class ResourceSet:
+    """Float resource arithmetic with tolerance (reference: fixed_point.h)."""
+
+    __slots__ = ("_r",)
+    EPS = 1e-9
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None):
+        self._r = dict(resources or {})
+
+    def get(self, name: str) -> float:
+        return self._r.get(name, 0.0)
+
+    def to_dict(self) -> Dict[str, float]:
+        return dict(self._r)
+
+    def fits(self, demand: Dict[str, float]) -> bool:
+        return all(self._r.get(k, 0.0) + self.EPS >= v for k, v in demand.items())
+
+    def acquire(self, demand: Dict[str, float]) -> bool:
+        if not self.fits(demand):
+            return False
+        for k, v in demand.items():
+            self._r[k] = self._r.get(k, 0.0) - v
+        return True
+
+    def release(self, demand: Dict[str, float]) -> None:
+        for k, v in demand.items():
+            self._r[k] = self._r.get(k, 0.0) + v
+
+    def add(self, other: Dict[str, float]) -> None:
+        for k, v in other.items():
+            self._r[k] = self._r.get(k, 0.0) + v
+
+    def utilization(self, total: "ResourceSet") -> float:
+        """Max over resources of used/total (hybrid-policy input)."""
+        u = 0.0
+        for k, cap in total._r.items():
+            if cap > 0:
+                u = max(u, (cap - self._r.get(k, 0.0)) / cap)
+        return u
+
+    def __repr__(self):
+        return f"ResourceSet({self._r})"
+
+
+@dataclass
+class TaskSpec:
+    """A normal-task invocation (reference: common/task/task_spec.h)."""
+
+    task_id: TaskID
+    job_id: JobID
+    function_key: str          # GCS function-store key
+    args: bytes                # framed serialized (args, kwargs)
+    arg_deps: List[ObjectID]   # objects that must be ready before dispatch
+    num_returns: int
+    resources: Dict[str, float]
+    name: str = ""
+    max_retries: int = 0
+    retries_left: int = 0
+    caller_id: str = ""        # client id of the submitter (owner)
+    owner_node: Optional[str] = None
+    scheduling_strategy: Any = None
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    runtime_env: Optional[dict] = None
+    submitted_at: float = field(default_factory=time.time)
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i)
+                for i in range(self.num_returns)]
+
+
+@dataclass
+class ActorCreationSpec:
+    """Actor creation (reference: gcs_actor_manager.h:281 registration)."""
+
+    actor_id: ActorID
+    job_id: JobID
+    class_key: str             # GCS function-store key for the pickled class
+    args: bytes                # framed serialized (args, kwargs) for __init__
+    arg_deps: List[ObjectID]
+    resources: Dict[str, float]
+    name: Optional[str] = None         # named actor
+    namespace: str = "default"
+    lifetime: Optional[str] = None     # None | "detached"
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    max_pending_calls: int = -1
+    is_async: bool = False
+    caller_id: str = ""
+    scheduling_strategy: Any = None
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    runtime_env: Optional[dict] = None
+    class_name: str = ""
+
+
+@dataclass
+class ActorTaskSpec:
+    """One actor method invocation (pushed caller -> actor node -> worker)."""
+
+    task_id: TaskID
+    actor_id: ActorID
+    job_id: JobID
+    method_name: str
+    args: bytes
+    arg_deps: List[ObjectID]
+    num_returns: int
+    caller_id: str = ""
+    seqno: int = 0
+    concurrency_group: str = ""
+    retries_left: int = 0
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i)
+                for i in range(self.num_returns)]
+
+
+@dataclass
+class Bundle:
+    """One placement-group bundle (reference: util/placement_group.py)."""
+
+    index: int
+    resources: Dict[str, float]
+    node_id: Optional[str] = None   # filled once placed
+
+
+@dataclass
+class PlacementGroupSpec:
+    pg_id: PlacementGroupID
+    bundles: List[Bundle]
+    strategy: str = "PACK"          # PACK|SPREAD|STRICT_PACK|STRICT_SPREAD
+    name: str = ""
+    lifetime: Optional[str] = None
+    caller_id: str = ""

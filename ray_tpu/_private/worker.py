@@ -1,21 +1,847 @@
-"""Core worker runtime (placeholder; full implementation in progress)."""
+"""Core worker: the in-process runtime of every driver and worker.
+
+Role-equivalent to the reference's core worker
+(reference: src/ray/core_worker/core_worker.h:284 — SubmitTask :735,
+SubmitActorTask :800, Put :506, Get :613) plus the Python-side driver state
+(reference: python/ray/_private/worker.py:406 Worker, init :1045).
+
+Data-plane design: objects live in the node's shared-memory store; ``get``
+blocks on the GCS object directory only for objects that are not yet local,
+then maps them zero-copy (same node) or pulls them from the holder node
+(reference: object directory ownership_based_object_directory.h:37 +
+PullManager pull_manager.h:52, collapsed into a directory lookup + one
+fetch RPC).
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.task_spec import (
+    ActorCreationSpec,
+    ActorTaskSpec,
+    TaskSpec,
+    normalize_resources,
+)
+from ray_tpu.object_store import plasma
+
+_INLINE_ARG_LIMIT = 512 * 1024  # larger arg blobs go through the object store
 
 
 class ObjectRef:
-    pass
+    """A future for a value in the object store (reference: ObjectID/ObjectRef
+    in _raylet.pyx). Picklable; reconnects to the ambient worker on loads."""
+
+    __slots__ = ("_id", "_owner_hint")
+
+    def __init__(self, object_id: ObjectID, owner_hint: str = ""):
+        self._id = object_id
+        self._owner_hint = owner_hint
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def task_id(self) -> TaskID:
+        return self._id.task_id()
+
+    def job_id(self) -> JobID:
+        return self._id.job_id()
+
+    def __reduce__(self):
+        return (_restore_ref, (self._id.binary(), self._owner_hint))
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def future(self):
+        """concurrent.futures.Future resolving to the object's value."""
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(require_worker().get([self])[0])
+            except BaseException as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
 
 
-def init(**kwargs):
-    raise NotImplementedError
+def _restore_ref(id_bytes: bytes, owner_hint: str) -> ObjectRef:
+    return ObjectRef(ObjectID(id_bytes), owner_hint)
+
+
+class _ObjArg:
+    """Marker for a top-level ObjectRef argument (resolved pre-execution)."""
+
+    __slots__ = ("id_bytes",)
+
+    def __init__(self, id_bytes: bytes):
+        self.id_bytes = id_bytes
+
+
+class _TaskContext(threading.local):
+    def __init__(self):
+        self.task_id: Optional[TaskID] = None
+        self.job_id: Optional[JobID] = None
+        self.actor_id: Optional[ActorID] = None
+        self.task_name: str = ""
+        self.put_index: int = 0
+
+
+class CoreWorker:
+    """Shared runtime for drivers and workers."""
+
+    def __init__(
+        self,
+        gcs_address: str,
+        role: str,                       # "driver" | "worker"
+        node_id: Optional[str] = None,
+        store_path: Optional[str] = None,
+        job_id: Optional[JobID] = None,
+        client_id: Optional[str] = None,
+    ):
+        self.role = role
+        self.client_id = client_id or uuid.uuid4().hex
+        self.gcs = protocol.connect(gcs_address, handler=self._on_gcs_msg,
+                                    name=f"{role}-gcs")
+        self.gcs_address = gcs_address
+        reply = self.gcs.request("register_client", {
+            "client_id": self.client_id,
+            "role": role,
+            "job_id": job_id,
+        })
+        self.job_id: JobID = reply["job_id"] if role == "driver" else job_id
+        self.node_id = node_id or reply["head_node_id"]
+        store_path = store_path or reply["head_store_path"]
+        if store_path is None:
+            raise RuntimeError("no object store available (no nodes?)")
+        self.store = plasma.PlasmaClient(store_path)
+
+        self.ctx = _TaskContext()
+        self._root_task_id = TaskID.for_task(self.job_id or JobID.from_int(0))
+        if role == "driver":
+            self.ctx.job_id = self.job_id
+            self.ctx.task_id = self._root_task_id
+        self.namespace = "default"
+
+        self._exported_functions: set = set()
+        self._function_cache: Dict[str, Any] = {}
+        self._nm_conns: Dict[str, protocol.Conn] = {}
+        self._nm_lock = threading.Lock()
+        # actor_id bytes -> {"address": str|None, "pending": [...], "info": {}}
+        self._actor_routes: Dict[bytes, Dict[str, Any]] = {}
+        self._actor_lock = threading.Lock()
+        self._actor_seqno: Dict[bytes, int] = {}
+        self._closed = False
+
+    # ----------------------------------------------------------- plumbing
+
+    def _on_gcs_msg(self, conn, mtype, payload, msg_id):
+        pass  # drivers/workers currently receive only replies
+
+    def nm_conn(self, address: str) -> protocol.Conn:
+        with self._nm_lock:
+            conn = self._nm_conns.get(address)
+            if conn is not None and not conn.closed:
+                return conn
+        conn = protocol.connect(address, name=f"{self.role}-nm")
+        with self._nm_lock:
+            existing = self._nm_conns.get(address)
+            if existing is not None and not existing.closed:
+                # lost the connect race; use the winner
+                conn.close()
+                return existing
+            self._nm_conns[address] = conn
+        return conn
+
+    def disconnect(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.gcs.close()
+        except Exception:
+            pass
+        with self._nm_lock:
+            for conn in self._nm_conns.values():
+                conn.close()
+            self._nm_conns.clear()
+        try:
+            self.store.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ functions
+
+    def export_function(self, blob: bytes) -> str:
+        key = hashlib.sha1(blob).hexdigest()
+        if key not in self._exported_functions:
+            self.gcs.request("put_function", {"key": key, "blob": blob})
+            self._exported_functions.add(key)
+        return key
+
+    def fetch_function(self, key: str):
+        fn = self._function_cache.get(key)
+        if fn is None:
+            blob = self.gcs.request("get_function", {"key": key})
+            if blob is None:
+                raise RuntimeError(f"function {key} not found in GCS")
+            fn = cloudpickle.loads(blob)
+            self._function_cache[key] = fn
+        return fn
+
+    # -------------------------------------------------------------- objects
+
+    def next_put_id(self) -> ObjectID:
+        # ctx is thread-local: user threads (and library threads, e.g. serve
+        # routers) fall back to a per-process root task id.
+        if self.ctx.task_id is None:
+            self.ctx.task_id = self._root_task_id
+            self.ctx.job_id = self.job_id
+        self.ctx.put_index += 1
+        return ObjectID.for_put(self.ctx.task_id, self.ctx.put_index)
+
+    def put(self, value) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put on an ObjectRef is not allowed")
+        oid = self.next_put_id()
+        size = self.store.put_value(oid.binary(), value)
+        self.gcs.notify("add_object_locations", {
+            "node_id": self.node_id,
+            "objects": [(oid.binary(), size)],
+        })
+        return ObjectRef(oid)
+
+    def put_serialized(self, sobj: serialization.SerializedObject) -> ObjectRef:
+        oid = self.next_put_id()
+        size = self.store.put_serialized(oid.binary(), sobj)
+        self.gcs.notify("add_object_locations", {
+            "node_id": self.node_id,
+            "objects": [(oid.binary(), size)],
+        })
+        return ObjectRef(oid)
+
+    def _store_local(self, oid: bytes, data: bytes) -> None:
+        try:
+            buf = self.store.create(oid, len(data))
+        except plasma.ObjectExistsError:
+            return
+        try:
+            buf[:] = data
+        finally:
+            del buf
+        self.store.seal(oid)
+
+    def ensure_local(self, id_bytes_list: List[bytes],
+                     timeout: Optional[float] = None) -> Dict[bytes, str]:
+        """Block until all ids are present in the local store.
+
+        Returns {id: failure_reason} for ids that failed instead. Raises
+        GetTimeoutError on timeout.
+        """
+        missing = [o for o in id_bytes_list if not self.store.contains(o)]
+        failures: Dict[bytes, str] = {}
+        if not missing:
+            return failures
+        deadline = time.time() + timeout if timeout is not None else None
+        pending = set(missing)
+        while pending:
+            t = None
+            if deadline is not None:
+                t = max(0.0, deadline - time.time())
+            reply = self.gcs.request("wait_for_objects", {
+                "object_ids": list(pending),
+                "num_returns": len(pending),
+                "timeout": t,
+            })
+            if reply.get("timeout"):
+                raise exceptions.GetTimeoutError(
+                    f"{len(pending)} object(s) not ready within timeout")
+            for oid, reason in (reply.get("failed") or {}).items():
+                failures[oid] = reason or "task failed"
+                pending.discard(oid)
+            ready = [o for o in reply["ready"] if o in pending]
+            if ready:
+                self._pull_objects(ready)
+                for o in ready:
+                    pending.discard(o)
+        return failures
+
+    def _pull_objects(self, id_bytes_list: List[bytes]) -> None:
+        """Fetch objects that are ready somewhere into the local store."""
+        to_pull = [o for o in id_bytes_list if not self.store.contains(o)]
+        if not to_pull:
+            return
+        locs = self.gcs.request("object_locations", {"object_ids": to_pull})
+        for oid in to_pull:
+            if self.store.contains(oid):
+                continue
+            info = locs.get(oid) or {}
+            for node_id, address in info.get("locations", []):
+                if node_id == self.node_id:
+                    # Listed as local but store.contains said no: either being
+                    # created right now or LRU-evicted. Try remote replicas
+                    # too rather than trusting the stale directory entry.
+                    continue
+                try:
+                    data = self.nm_conn(address).request(
+                        "fetch_object", {"object_id": oid}, timeout=60)
+                except (protocol.ConnectionClosed, TimeoutError):
+                    continue
+                if data is not None:
+                    self._store_local(oid, data)
+                    self.gcs.notify("add_object_locations", {
+                        "node_id": self.node_id,
+                        "objects": [(oid, len(data))],
+                    })
+                    break
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        if not isinstance(refs, (list, tuple)):
+            raise TypeError(
+                f"get() expects an ObjectRef or list, got {type(refs)}")
+        for r in refs:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() list items must be ObjectRef, got "
+                                f"{type(r)}")
+        ids = [r.binary() for r in refs]
+        failures = self.ensure_local(ids, timeout=timeout)
+        out = []
+        for oid in ids:
+            if oid in failures and not self.store.contains(oid):
+                raise _error_from_reason(failures[oid])
+            value, ok = self.store.get_value(oid, timeout_ms=30_000)
+            if not ok:
+                raise exceptions.ObjectLostError(oid.hex())
+            if isinstance(value, exceptions.RayTaskError):
+                raise value.as_instanceof_cause()
+            if isinstance(value, exceptions.RayTpuError):
+                raise value
+            out.append(value)
+        return out[0] if single else out
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if isinstance(refs, ObjectRef):
+            raise TypeError("wait() expects a list of ObjectRefs")
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds the number of refs")
+        if len(set(r.binary() for r in refs)) != len(refs):
+            raise ValueError("wait() got duplicate ObjectRefs")
+        ids = [r.binary() for r in refs]
+        local = {o for o in ids if self.store.contains(o)}
+        ready_set = set(local)
+        if len(ready_set) < num_returns:
+            reply = self.gcs.request("wait_for_objects", {
+                "object_ids": [o for o in ids if o not in ready_set],
+                "num_returns": num_returns - len(ready_set),
+                "timeout": timeout if timeout is not None else None,
+            })
+            ready_set.update(reply["ready"])
+            ready_set.update(reply.get("failed") or {})
+        ready, not_ready = [], []
+        for r in refs:
+            if r.binary() in ready_set and len(ready) < num_returns:
+                ready.append(r)
+            else:
+                not_ready.append(r)
+        if fetch_local and ready:
+            try:
+                self._pull_objects([r.binary() for r in ready])
+            except Exception:
+                pass
+        return ready, not_ready
+
+    def free(self, refs: Sequence[ObjectRef]):
+        self.gcs.request("free_objects",
+                         {"object_ids": [r.binary() for r in refs]})
+
+    # ---------------------------------------------------------------- tasks
+
+    def _serialize_args(self, args, kwargs) -> Tuple[Any, List[ObjectID]]:
+        deps: List[ObjectID] = []
+        proc_args = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                deps.append(a.id)
+                proc_args.append(_ObjArg(a.binary()))
+            else:
+                proc_args.append(a)
+        proc_kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, ObjectRef):
+                deps.append(v.id)
+                proc_kwargs[k] = _ObjArg(v.binary())
+            else:
+                proc_kwargs[k] = v
+        sobj = serialization.serialize((proc_args, proc_kwargs))
+        if sobj.total_size() > _INLINE_ARG_LIMIT:
+            ref = self.put_serialized(sobj)
+            deps.append(ref.id)
+            return ("ref", ref.binary()), deps
+        return sobj.to_bytes(), deps
+
+    def deserialize_args(self, args_blob) -> Tuple[tuple, dict]:
+        if isinstance(args_blob, tuple) and args_blob[0] == "ref":
+            oid = args_blob[1]
+            failures = self.ensure_local([oid])
+            if failures:
+                raise _error_from_reason(failures[oid])
+            value, ok = self.store.get_value(oid, timeout_ms=30_000)
+            if not ok:
+                raise exceptions.ObjectLostError(oid.hex())
+            proc_args, proc_kwargs = value
+        else:
+            proc_args, proc_kwargs = serialization.loads_oob(args_blob)
+        # Resolve top-level ObjectRef placeholders to their values.
+        need = [a.id_bytes for a in proc_args if isinstance(a, _ObjArg)]
+        need += [v.id_bytes for v in proc_kwargs.values()
+                 if isinstance(v, _ObjArg)]
+        if need:
+            failures = self.ensure_local(need)
+            resolved: Dict[bytes, Any] = {}
+            for oid in need:
+                if oid in failures and not self.store.contains(oid):
+                    raise _error_from_reason(failures[oid])
+                value, ok = self.store.get_value(oid, timeout_ms=30_000)
+                if not ok:
+                    raise exceptions.ObjectLostError(oid.hex())
+                if isinstance(value, exceptions.RayTaskError):
+                    raise value.as_instanceof_cause()
+                if isinstance(value, exceptions.RayTpuError):
+                    raise value
+                resolved[oid] = value
+            proc_args = [resolved[a.id_bytes] if isinstance(a, _ObjArg) else a
+                         for a in proc_args]
+            proc_kwargs = {k: resolved[v.id_bytes] if isinstance(v, _ObjArg)
+                           else v for k, v in proc_kwargs.items()}
+        return tuple(proc_args), proc_kwargs
+
+    def submit_task(self, function_key: str, args, kwargs, *,
+                    name: str = "", num_returns: int = 1,
+                    resources: Dict[str, float],
+                    max_retries: int = 0,
+                    scheduling_strategy=None,
+                    placement_group=None,
+                    placement_group_bundle_index: int = -1,
+                    runtime_env=None) -> List[ObjectRef]:
+        args_blob, deps = self._serialize_args(args, kwargs)
+        task_id = TaskID.for_task(self.job_id)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            function_key=function_key,
+            args=args_blob,
+            arg_deps=deps,
+            num_returns=num_returns,
+            resources=resources,
+            name=name,
+            max_retries=max_retries,
+            caller_id=self.client_id,
+            owner_node=self.node_id,
+            scheduling_strategy=scheduling_strategy,
+            placement_group_id=(placement_group.id
+                                if placement_group is not None else None),
+            placement_group_bundle_index=placement_group_bundle_index,
+            runtime_env=runtime_env,
+        )
+        self.gcs.notify("submit_task", spec)
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def cancel(self, ref: ObjectRef, force: bool = False,
+               recursive: bool = True):
+        self.gcs.request("cancel_task", {
+            "task_id": ref.task_id().binary(), "force": force})
+
+    # --------------------------------------------------------------- actors
+
+    def create_actor(self, class_key: str, args, kwargs, *,
+                     class_name: str,
+                     resources: Dict[str, float],
+                     name: Optional[str] = None,
+                     namespace: Optional[str] = None,
+
+                     lifetime: Optional[str] = None,
+                     max_restarts: int = 0,
+                     max_task_retries: int = 0,
+                     max_concurrency: int = 1,
+                     is_async: bool = False,
+                     scheduling_strategy=None,
+                     placement_group=None,
+                     placement_group_bundle_index: int = -1,
+                     runtime_env=None) -> ActorID:
+        args_blob, deps = self._serialize_args(args, kwargs)
+        actor_id = ActorID.of(self.job_id)
+        spec = ActorCreationSpec(
+            actor_id=actor_id,
+            job_id=self.job_id,
+            class_key=class_key,
+            args=args_blob,
+            arg_deps=deps,
+            resources=resources,
+            name=name,
+            namespace=namespace or self.namespace,
+            lifetime=lifetime,
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            is_async=is_async,
+            caller_id=self.client_id,
+            scheduling_strategy=scheduling_strategy,
+            placement_group_id=(placement_group.id
+                                if placement_group is not None else None),
+            placement_group_bundle_index=placement_group_bundle_index,
+            runtime_env=runtime_env,
+            class_name=class_name,
+        )
+        self.gcs.request("create_actor", spec)
+        with self._actor_lock:
+            self._actor_routes[actor_id.binary()] = {
+                "address": None, "pending": [], "resolving": False,
+                "info": {"max_task_retries": max_task_retries},
+            }
+        return actor_id
+
+    def _route_for(self, actor_id_bytes: bytes) -> Dict[str, Any]:
+        with self._actor_lock:
+            route = self._actor_routes.get(actor_id_bytes)
+            if route is None:
+                route = {"address": None, "pending": [], "resolving": False,
+                         "info": {}}
+                self._actor_routes[actor_id_bytes] = route
+            return route
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args, kwargs, *, num_returns: int = 1,
+                          concurrency_group: str = "") -> List[ObjectRef]:
+        args_blob, deps = self._serialize_args(args, kwargs)
+        aid = actor_id.binary()
+        task_id = TaskID.for_actor_task(actor_id)
+        with self._actor_lock:
+            seq = self._actor_seqno.get(aid, 0)
+            self._actor_seqno[aid] = seq + 1
+        spec = ActorTaskSpec(
+            task_id=task_id,
+            actor_id=actor_id,
+            job_id=self.job_id,
+            method_name=method_name,
+            args=args_blob,
+            arg_deps=deps,
+            num_returns=num_returns,
+            caller_id=self.client_id,
+            seqno=seq,
+            concurrency_group=concurrency_group,
+        )
+        self._dispatch_actor_task(spec)
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def _dispatch_actor_task(self, spec: ActorTaskSpec):
+        aid = spec.actor_id.binary()
+        route = self._route_for(aid)
+        with self._actor_lock:
+            addr = route["address"]
+            if addr is None:
+                route["pending"].append(spec)
+                if not route["resolving"]:
+                    route["resolving"] = True
+                    need_resolve = True
+                else:
+                    need_resolve = False
+            else:
+                need_resolve = False
+        if addr is not None:
+            try:
+                self.nm_conn(addr).notify("submit_actor_task", spec)
+                return
+            except protocol.ConnectionClosed:
+                with self._actor_lock:
+                    route["address"] = None
+                    route["pending"].append(spec)
+                    if not route["resolving"]:
+                        route["resolving"] = True
+                        need_resolve = True
+        if need_resolve:
+            fut = self.gcs.request_nowait("resolve_actor", {"actor_id": aid})
+
+            def on_done():
+                try:
+                    info = fut.result(timeout=None)
+                except BaseException:
+                    info = {"state": "DEAD", "node_address": None}
+                self._on_actor_resolved(aid, info)
+
+            threading.Thread(target=on_done, daemon=True).start()
+
+    def _on_actor_resolved(self, aid: bytes, info: dict):
+        route = self._route_for(aid)
+        addr = (info or {}).get("node_address") \
+            if (info or {}).get("state") == "ALIVE" else None
+        conn = None
+        if addr is not None:
+            # Pre-establish the connection outside the lock.
+            try:
+                conn = self.nm_conn(addr)
+            except (protocol.ConnectionClosed, ConnectionError, OSError):
+                conn = None
+        # Flush the parked calls and publish the address while holding the
+        # lock, so later calls (which go direct once the address is visible)
+        # cannot overtake the parked ones (per-caller FIFO, reference:
+        # direct_actor_task_submitter.h sequencing).
+        unsent = []
+        with self._actor_lock:
+            route["resolving"] = False
+            route["info"].update(info or {})
+            pending, route["pending"] = route["pending"], []
+            if conn is not None:
+                try:
+                    for i, spec in enumerate(pending):
+                        conn.notify("submit_actor_task", spec)
+                except protocol.ConnectionClosed:
+                    unsent = pending[i:]
+                else:
+                    route["address"] = addr
+            else:
+                unsent = pending
+        # Dead or unreachable: let the GCS materialize / reroute.
+        for spec in unsent:
+            try:
+                self.gcs.notify("reroute_actor_task", spec)
+            except Exception:
+                pass
+
+    def resolve_actor_blocking(self, actor_id: ActorID,
+                               timeout: Optional[float] = None) -> dict:
+        return self.gcs.request("resolve_actor",
+                                {"actor_id": actor_id.binary()},
+                                timeout=timeout)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        with self._actor_lock:
+            route = self._actor_routes.get(actor_id.binary())
+            if route is not None:
+                route["address"] = None
+        self.gcs.request("kill_actor", {
+            "actor_id": actor_id.binary(), "no_restart": no_restart})
+
+    def get_actor_info_by_name(self, name: str,
+                               namespace: Optional[str] = None):
+        return self.gcs.request("get_actor_by_name", {
+            "name": name, "namespace": namespace or self.namespace})
+
+    # -------------------------------------------------------------- cluster
+
+    def available_resources(self) -> dict:
+        return self.gcs.request("available_resources")
+
+    def cluster_resources(self) -> dict:
+        return self.gcs.request("cluster_resources")
+
+    def nodes(self) -> List[dict]:
+        return self.gcs.request("nodes")
+
+    def timeline(self) -> List[dict]:
+        return self.gcs.request("get_timeline")
+
+    def kv(self):
+        return KvClient(self.gcs)
+
+
+class KvClient:
+    """Internal KV (reference: gcs_kv_manager.h:101 / ray.experimental
+    internal_kv)."""
+
+    def __init__(self, gcs_conn):
+        self._gcs = gcs_conn
+
+    def put(self, key: bytes, value: bytes, overwrite: bool = True,
+            namespace: str = "") -> bool:
+        return self._gcs.request("kv_put", {
+            "ns": namespace, "key": key, "value": value,
+            "overwrite": overwrite})
+
+    def get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
+        return self._gcs.request("kv_get", {"ns": namespace, "key": key})
+
+    def delete(self, key: bytes, namespace: str = "") -> bool:
+        return self._gcs.request("kv_del", {"ns": namespace, "key": key})
+
+    def exists(self, key: bytes, namespace: str = "") -> bool:
+        return self._gcs.request("kv_exists", {"ns": namespace, "key": key})
+
+    def keys(self, prefix: bytes = b"", namespace: str = "") -> List[bytes]:
+        return self._gcs.request("kv_keys", {"ns": namespace,
+                                             "prefix": prefix})
+
+
+def _error_from_reason(reason: Optional[str]) -> BaseException:
+    reason = reason or "task failed"
+    if "cancel" in reason:
+        return exceptions.TaskCancelledError()
+    if "actor" in reason:
+        return exceptions.RayActorError(msg=reason)
+    if "node died" in reason or "worker died" in reason:
+        return exceptions.WorkerCrashedError(reason)
+    return exceptions.RayTaskError("", reason)
+
+
+# ---------------------------------------------------------------- driver glue
+
+_global_worker: Optional[CoreWorker] = None
+_global_cluster = None   # _LocalCluster when we started the control plane
+_init_lock = threading.RLock()
+
+
+class _LocalCluster:
+    """In-process head: GCS + head-node manager (reference: the head node's
+    gcs_server + raylet processes, started by _private/node.py:1145)."""
+
+    def __init__(self, num_cpus, num_tpus, resources, object_store_memory,
+                 system_config=None):
+        from ray_tpu._private.gcs import GcsServer
+
+        if system_config:
+            from ray_tpu._private.config import config as global_config
+            global_config.apply_system_config(system_config)
+        self.session_dir = os.path.join(
+            "/tmp", "ray_tpu", f"session_{int(time.time()*1000)}_{os.getpid()}")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.gcs = GcsServer()
+        from ray_tpu._private.node_manager import NodeManager
+
+        if num_cpus is None:
+            num_cpus = os.cpu_count() or 4
+        self.nm = NodeManager(
+            gcs_address=self.gcs.address,
+            session_dir=self.session_dir,
+            num_cpus=num_cpus,
+            num_tpus=num_tpus or 0,
+            resources=resources,
+            object_store_memory=object_store_memory or (1 << 30),
+            is_head=True,
+            node_name="head",
+        )
+        self.address = self.gcs.address
+
+    def shutdown(self):
+        try:
+            self.nm.shutdown()
+        except Exception:
+            pass
+        try:
+            self.gcs.close()
+        except Exception:
+            pass
+        import shutil
+
+        shutil.rmtree(self.session_dir, ignore_errors=True)
+
+
+class ClientContext:
+    def __init__(self, address: str, worker: CoreWorker):
+        self.address_info = {"address": address,
+                             "node_id": worker.node_id}
+        self.dashboard_url = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        shutdown()
+
+
+def init(address=None, num_cpus=None, num_tpus=None, resources=None,
+         object_store_memory=None, namespace=None,
+         ignore_reinit_error=False, runtime_env=None, system_config=None,
+         log_to_driver=True) -> ClientContext:
+    global _global_worker, _global_cluster
+    with _init_lock:
+        if _global_worker is not None:
+            if ignore_reinit_error:
+                return ClientContext(_global_worker.gcs_address,
+                                     _global_worker)
+            raise RuntimeError(
+                "ray_tpu.init() called twice; pass ignore_reinit_error=True "
+                "or call ray_tpu.shutdown() first")
+        if address in (None, "local"):
+            _global_cluster = _LocalCluster(
+                num_cpus, num_tpus, resources, object_store_memory,
+                system_config)
+            gcs_address = _global_cluster.address
+        else:
+            if address == "auto":
+                address = os.environ.get("RAY_TPU_ADDRESS")
+                if not address:
+                    raise ConnectionError(
+                        "address='auto' but RAY_TPU_ADDRESS is not set")
+            gcs_address = address
+        worker = CoreWorker(gcs_address, role="driver")
+        if namespace:
+            worker.namespace = namespace
+        _global_worker = worker
+        atexit.register(_atexit_shutdown)
+        return ClientContext(gcs_address, worker)
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
 
 
 def shutdown():
-    pass
+    global _global_worker, _global_cluster
+    with _init_lock:
+        if _global_worker is not None:
+            _global_worker.disconnect()
+            _global_worker = None
+        if _global_cluster is not None:
+            _global_cluster.shutdown()
+            _global_cluster = None
 
 
-def global_worker():
-    return None
+def global_worker() -> Optional[CoreWorker]:
+    return _global_worker
 
 
-def require_worker():
-    raise RuntimeError("ray_tpu.init() has not been called")
+def set_global_worker(w: CoreWorker):
+    global _global_worker
+    _global_worker = w
+
+
+def require_worker() -> CoreWorker:
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu.init() has not been called on this process")
+    return _global_worker

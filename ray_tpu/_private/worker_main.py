@@ -1,0 +1,408 @@
+"""Worker process entrypoint and task execution loop.
+
+Role-equivalent to the reference's default_worker.py:226 +
+``execute_task`` (reference: python/ray/_raylet.pyx:702) +
+the execution-side scheduling queues (reference:
+src/ray/core_worker/transport/actor_scheduling_queue.h,
+concurrency_group_manager.h): a worker registers with its node manager,
+receives task pushes over that connection, and executes them on the main
+thread (normal tasks, sync actors), an asyncio loop (async actors), or a
+thread pool (threaded actors).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import ctypes
+import inspect
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.ids import ActorID, JobID, TaskID
+from ray_tpu._private.task_spec import ActorCreationSpec, ActorTaskSpec, TaskSpec
+from ray_tpu._private.worker import CoreWorker, set_global_worker
+from ray_tpu.object_store import plasma
+
+
+class WorkerExecutor:
+    def __init__(self, core: CoreWorker, nm_address: str, worker_id: bytes):
+        self.core = core
+        self.worker_id = worker_id
+        self._queue: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._running = True
+        self._current_task_id: Optional[bytes] = None
+        self._cancel_requested: Optional[bytes] = None
+
+        # actor state
+        self.actor_instance: Any = None
+        self.actor_spec: Optional[ActorCreationSpec] = None
+        self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._aio_sem: Optional[asyncio.Semaphore] = None
+        self._thread_pool = None
+
+        signal.signal(signal.SIGUSR1, self._on_cancel_signal)
+
+        self.nm = protocol.connect(nm_address, handler=self._on_msg,
+                                   name="worker-nm")
+        self.nm.on_close = lambda conn: self._on_nm_closed()
+        reply = self.nm.request("register_worker", {
+            "worker_id": worker_id, "pid": os.getpid()})
+        self.node_id = reply["node_id"]
+
+    # ------------------------------------------------------------- plumbing
+
+    def _on_nm_closed(self):
+        # Node manager went away: nothing to live for.
+        os._exit(0)
+
+    def _on_msg(self, conn, mtype, payload, msg_id):
+        if mtype == "cancel_task":
+            self._handle_cancel(payload["task_id"])
+            return
+        if mtype == "exit":
+            with self._cv:
+                self._running = False
+                self._cv.notify()
+            return
+        if mtype == "run_actor_task" and self._aio_loop is not None:
+            # async actor: schedule concurrently, don't serialize on the queue
+            asyncio.run_coroutine_threadsafe(
+                self._run_actor_task_async(payload), self._aio_loop)
+            return
+        if mtype == "run_actor_task" and self._thread_pool is not None:
+            self._thread_pool.submit(self._execute_actor_task, payload)
+            return
+        with self._cv:
+            self._queue.append((mtype, payload))
+            self._cv.notify()
+
+    def _handle_cancel(self, task_id: bytes):
+        with self._cv:
+            for item in list(self._queue):
+                mtype, payload = item
+                if mtype == "run_task" and \
+                        payload.task_id.binary() == task_id:
+                    self._queue.remove(item)
+                    self._store_error_returns(
+                        payload, exceptions.TaskCancelledError(
+                            task_id.hex()))
+                    self._task_done(payload, "error", [], "cancelled")
+                    return
+            if self._current_task_id == task_id:
+                self._cancel_requested = task_id
+                os.kill(os.getpid(), signal.SIGUSR1)
+
+    def _on_cancel_signal(self, signum, frame):
+        if (self._cancel_requested is not None
+                and self._cancel_requested == self._current_task_id):
+            self._cancel_requested = None
+            raise exceptions.TaskCancelledError(
+                self._current_task_id.hex()
+                if self._current_task_id else "")
+
+    # ------------------------------------------------------------ main loop
+
+    def run(self):
+        while True:
+            with self._cv:
+                while self._running and not self._queue:
+                    self._cv.wait()
+                if not self._running:
+                    break
+                mtype, payload = self._queue.popleft()
+            try:
+                if mtype == "run_task":
+                    self._execute_task(payload)
+                elif mtype == "create_actor":
+                    self._create_actor(payload)
+                elif mtype == "run_actor_task":
+                    # Tasks that raced in before the constructor finished get
+                    # re-routed to the concurrency executor chosen at creation.
+                    if self._aio_loop is not None:
+                        asyncio.run_coroutine_threadsafe(
+                            self._run_actor_task_async(payload),
+                            self._aio_loop)
+                    elif self._thread_pool is not None:
+                        self._thread_pool.submit(
+                            self._execute_actor_task, payload)
+                    else:
+                        self._execute_actor_task(payload)
+            except SystemExit:
+                raise
+            except BaseException:
+                traceback.print_exc()
+
+    # ------------------------------------------------------------ execution
+
+    def _store_returns(self, spec, result) -> list:
+        ids = spec.return_ids()
+        if not ids:
+            return []
+        if len(ids) == 1:
+            values = [result]
+        else:
+            if not isinstance(result, (tuple, list)) or \
+                    len(result) != len(ids):
+                raise ValueError(
+                    f"task declared num_returns={len(ids)} but returned "
+                    f"{type(result).__name__}")
+            values = list(result)
+        out = []
+        for oid, value in zip(ids, values):
+            sobj = serialization.serialize(value)
+            try:
+                self.core.store.put_serialized(oid.binary(), sobj)
+            except plasma.ObjectExistsError:
+                pass
+            out.append((oid.binary(), sobj.total_size()))
+        return out
+
+    def _store_error_returns(self, spec, err: BaseException) -> list:
+        blob = serialization.serialize(err)
+        out = []
+        for oid in spec.return_ids():
+            try:
+                self.core.store.put_serialized(oid.binary(), blob)
+            except plasma.ObjectExistsError:
+                pass
+            out.append((oid.binary(), blob.total_size()))
+        return out
+
+    def _task_done(self, spec, status: str, objects: list,
+                   error: Optional[str] = None):
+        try:
+            self.nm.notify("task_done", {
+                "task_id": spec.task_id.binary(),
+                "status": status,
+                "objects": objects,
+                "error": error,
+            })
+        except protocol.ConnectionClosed:
+            os._exit(0)
+
+    def _set_ctx(self, spec, actor_id: Optional[ActorID] = None):
+        ctx = self.core.ctx
+        ctx.task_id = spec.task_id
+        ctx.job_id = spec.job_id
+        ctx.actor_id = actor_id
+        ctx.task_name = getattr(spec, "name",
+                                getattr(spec, "method_name", ""))
+        ctx.put_index = 0
+        self.core.job_id = spec.job_id
+
+    def _execute_task(self, spec: TaskSpec):
+        self._current_task_id = spec.task_id.binary()
+        self._set_ctx(spec)
+        start = time.time()
+        try:
+            fn = self.core.fetch_function(spec.function_key)
+            args, kwargs = self.core.deserialize_args(spec.args)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            objects = self._store_returns(spec, result)
+            status, error = "ok", None
+        except BaseException as e:
+            err = exceptions.RayTaskError.from_exception(
+                spec.name or spec.function_key[:8], e)
+            objects = self._store_error_returns(spec, err)
+            status, error = "error", str(e)
+        finally:
+            self._current_task_id = None
+            self._cancel_requested = None
+        self._task_done(spec, status, objects, error)
+        self._report_event(spec.task_id, spec.name, start, status,
+                           kind="task")
+
+    def _create_actor(self, spec: ActorCreationSpec):
+        self.actor_spec = spec
+        self._current_task_id = None
+        try:
+            cls = self.core.fetch_function(spec.class_key)
+            args, kwargs = self.core.deserialize_args(spec.args)
+            self.core.ctx.job_id = spec.job_id
+            self.core.ctx.actor_id = spec.actor_id
+            self.core.ctx.task_id = TaskID.for_actor_creation(spec.actor_id)
+            self.core.job_id = spec.job_id
+            self.actor_instance = cls(*args, **kwargs)
+        except BaseException as e:
+            tb = traceback.format_exc()
+            try:
+                self.nm.notify("actor_failed", {
+                    "actor_id": spec.actor_id.binary(),
+                    "error": f"{type(e).__name__}: {e}\n{tb}"})
+            except protocol.ConnectionClosed:
+                pass
+            os._exit(1)
+        if spec.is_async:
+            self._start_aio_loop(spec.max_concurrency)
+        elif spec.max_concurrency > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._thread_pool = ThreadPoolExecutor(
+                max_workers=spec.max_concurrency,
+                thread_name_prefix="rtpu-actor")
+        try:
+            self.nm.notify("actor_ready",
+                           {"actor_id": spec.actor_id.binary()})
+        except protocol.ConnectionClosed:
+            os._exit(0)
+
+    def _start_aio_loop(self, max_concurrency: int):
+        loop = asyncio.new_event_loop()
+        self._aio_loop = loop
+
+        def runner():
+            asyncio.set_event_loop(loop)
+            self._aio_sem = asyncio.Semaphore(max_concurrency)
+            loop.run_forever()
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name="rtpu-actor-aio")
+        t.start()
+        while self._aio_sem is None:
+            time.sleep(0.001)
+
+    def _resolve_method(self, name: str):
+        if name == "__ray_ready__":
+            return lambda: True
+        if name == "__ray_terminate__":
+            return self._terminate_actor
+        method = getattr(self.actor_instance, name, None)
+        if method is None:
+            raise AttributeError(
+                f"{type(self.actor_instance).__name__} has no method "
+                f"'{name}'")
+        return method
+
+    def _terminate_actor(self):
+        try:
+            self.nm.notify("actor_exit", {
+                "actor_id": self.actor_spec.actor_id.binary()})
+        except protocol.ConnectionClosed:
+            pass
+        # flush task_done for the terminate call happens in caller; exit soon
+        threading.Thread(target=self._delayed_exit, daemon=True).start()
+        return None
+
+    @staticmethod
+    def _delayed_exit():
+        time.sleep(0.1)
+        os._exit(0)
+
+    def _execute_actor_task(self, spec: ActorTaskSpec):
+        self._current_task_id = spec.task_id.binary()
+        self._set_ctx(spec, actor_id=spec.actor_id)
+        start = time.time()
+        exit_after = False
+        try:
+            method = self._resolve_method(spec.method_name)
+            args, kwargs = self.core.deserialize_args(spec.args)
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            objects = self._store_returns(spec, result)
+            status, error = "ok", None
+        except SystemExit:
+            # ray_tpu.actor.exit_actor(): graceful, expected termination.
+            try:
+                self.nm.notify("actor_exit", {
+                    "actor_id": self.actor_spec.actor_id.binary()})
+            except protocol.ConnectionClosed:
+                pass
+            objects = self._store_returns(spec, None)
+            status, error = "ok", None
+            exit_after = True
+        except BaseException as e:
+            err = exceptions.RayTaskError.from_exception(
+                f"{spec.method_name}", e)
+            objects = self._store_error_returns(spec, err)
+            status, error = "error", str(e)
+        finally:
+            self._current_task_id = None
+            self._cancel_requested = None
+        self._task_done(spec, status, objects, error)
+        self._report_event(spec.task_id, spec.method_name, start, status,
+                           kind="actor_task")
+        if exit_after:
+            self._delayed_exit()
+
+    async def _run_actor_task_async(self, spec: ActorTaskSpec):
+        async with self._aio_sem:
+            start = time.time()
+            try:
+                method = self._resolve_method(spec.method_name)
+                args, kwargs = self.core.deserialize_args(spec.args)
+                result = method(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = await result
+                objects = self._store_returns(spec, result)
+                status, error = "ok", None
+            except BaseException as e:
+                err = exceptions.RayTaskError.from_exception(
+                    spec.method_name, e)
+                objects = self._store_error_returns(spec, err)
+                status, error = "error", str(e)
+            self._task_done(spec, status, objects, error)
+            self._report_event(spec.task_id, spec.method_name, start, status,
+                               kind="actor_task")
+
+    def _report_event(self, task_id: TaskID, name: str, start: float,
+                      status: str, kind: str):
+        try:
+            self.core.gcs.notify("task_events", [{
+                "task_id": task_id.hex(),
+                "name": name,
+                "kind": kind,
+                "node_id": self.node_id,
+                "worker_id": self.worker_id.hex(),
+                "pid": os.getpid(),
+                "start": start,
+                "end": time.time(),
+                "status": status,
+            }])
+        except Exception:
+            pass
+
+
+def main():
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR2, all_threads=True)
+    worker_id = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])
+    nm_address = os.environ["RAY_TPU_NM_ADDRESS"]
+    gcs_address = os.environ["RAY_TPU_GCS_ADDRESS"]
+    store_path = os.environ["RAY_TPU_STORE_PATH"]
+    node_id = os.environ["RAY_TPU_NODE_ID"]
+
+    try:
+        core = CoreWorker(
+            gcs_address,
+            role="worker",
+            node_id=node_id,
+            store_path=store_path,
+            job_id=JobID.from_int(0),
+            client_id=f"worker-{worker_id.hex()[:12]}",
+        )
+    except (ConnectionError, OSError):
+        # cluster is already gone (shutdown race); exit quietly
+        sys.exit(0)
+    set_global_worker(core)
+    executor = WorkerExecutor(core, nm_address, worker_id)
+    try:
+        executor.run()
+    finally:
+        core.disconnect()
+
+
+if __name__ == "__main__":
+    main()

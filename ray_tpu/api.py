@@ -88,11 +88,22 @@ def cancel(ref: "ObjectRef", *, force: bool = False, recursive: bool = True):
 
 
 def kill(actor, *, no_restart: bool = True):
-    return _worker_mod.require_worker().kill_actor(actor, no_restart=no_restart)
+    from ray_tpu.actor import ActorHandle
+
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("ray_tpu.kill() expects an actor handle")
+    return _worker_mod.require_worker().kill_actor(
+        actor._actor_id, no_restart=no_restart)
 
 
 def get_actor(name: str, namespace: Optional[str] = None):
-    return _worker_mod.require_worker().get_actor(name, namespace=namespace)
+    from ray_tpu.actor import ActorHandle
+
+    info = _worker_mod.require_worker().get_actor_info_by_name(
+        name, namespace=namespace)
+    if info is None:
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    return ActorHandle(info["actor_id"], class_name=info.get("class_name", ""))
 
 
 def get_runtime_context():

@@ -36,12 +36,12 @@ constexpr uint32_t kIdSize = 28;
 constexpr uint64_t kAlign = 64;
 constexpr uint64_t kNil = ~0ULL;
 
-// Entry states.
+// Entry states. Deletion uses backward-shift compaction (no tombstones), so
+// probe chains stay short regardless of create/delete churn.
 enum : uint8_t {
   kEmpty = 0,
   kCreated = 1,   // allocated, writer still filling it
   kSealed = 2,    // immutable, readable
-  kTombstone = 3, // deleted; keeps probe chains intact
 };
 
 struct Entry {
@@ -50,7 +50,8 @@ struct Entry {
   uint8_t pad[3];
   int32_t refcount;     // pinned readers/writers; evictable only at 0
   uint64_t offset;      // data offset from arena base
-  uint64_t size;
+  uint64_t size;        // logical (requested) size
+  uint64_t alloc_size;  // bytes actually taken from the free list
   uint64_t lru_prev;    // entry index + 1; 0 = none
   uint64_t lru_next;
 };
@@ -136,7 +137,9 @@ void lru_push_tail(Handle* h, uint64_t idx1) {
 
 // ---- free-list allocator (address-ordered first fit with coalescing) ----
 
-uint64_t alloc_data(Handle* h, uint64_t size) {
+// Allocates >= size bytes; *actual_out receives the true block size taken
+// (absorbed slivers included) so frees return exactly what was charged.
+uint64_t alloc_data(Handle* h, uint64_t size, uint64_t* actual_out) {
   size = align_up(size ? size : kAlign);
   ArenaHeader* hdr = h->hdr;
   uint64_t prev = kNil;
@@ -159,6 +162,7 @@ uint64_t alloc_data(Handle* h, uint64_t size) {
         else reinterpret_cast<FreeBlock*>(h->base + prev)->next = blk->next;
       }
       hdr->used_bytes += size;
+      *actual_out = size;
       return cur;
     }
     prev = cur;
@@ -168,7 +172,6 @@ uint64_t alloc_data(Handle* h, uint64_t size) {
 }
 
 void free_data(Handle* h, uint64_t offset, uint64_t size) {
-  size = align_up(size ? size : kAlign);
   ArenaHeader* hdr = h->hdr;
   hdr->used_bytes -= size;
   // Insert address-ordered, coalescing with neighbors.
@@ -208,7 +211,7 @@ uint64_t find_entry(Handle* h, const uint8_t* id) {
   for (uint64_t probes = 0; probes <= mask; probes++, i = (i + 1) & mask) {
     Entry* e = &h->entries[i];
     if (e->state == kEmpty) return kNil;
-    if (e->state != kTombstone && memcmp(e->id, id, kIdSize) == 0) return i;
+    if (memcmp(e->id, id, kIdSize) == 0) return i;
   }
   return kNil;
 }
@@ -217,21 +220,52 @@ uint64_t find_entry(Handle* h, const uint8_t* id) {
 uint64_t find_slot(Handle* h, const uint8_t* id, uint64_t* found) {
   uint64_t mask = h->hdr->mask;
   uint64_t i = hash_id(id) & mask;
-  uint64_t first_tomb = kNil;
   *found = kNil;
   for (uint64_t probes = 0; probes <= mask; probes++, i = (i + 1) & mask) {
     Entry* e = &h->entries[i];
-    if (e->state == kEmpty) {
-      return first_tomb != kNil ? first_tomb : i;
-    }
-    if (e->state == kTombstone) {
-      if (first_tomb == kNil) first_tomb = i;
-    } else if (memcmp(e->id, id, kIdSize) == 0) {
+    if (e->state == kEmpty) return i;
+    if (memcmp(e->id, id, kIdSize) == 0) {
       *found = i;
       return kNil;
     }
   }
-  return first_tomb;
+  return kNil;
+}
+
+// Re-links LRU neighbors after an entry moved from index `from` to `to`.
+// (Only sealed refcount==0 entries are linked; for others the fields are 0
+// and the head/tail checks cannot match, so this is a safe no-op.)
+void lru_fixup_moved(Handle* h, uint64_t from, uint64_t to) {
+  Entry* e = &h->entries[to];
+  if (e->lru_prev) h->entries[e->lru_prev - 1].lru_next = to + 1;
+  else if (h->hdr->lru_head == from + 1) h->hdr->lru_head = to + 1;
+  if (e->lru_next) h->entries[e->lru_next - 1].lru_prev = to + 1;
+  else if (h->hdr->lru_tail == from + 1) h->hdr->lru_tail = to + 1;
+}
+
+// Remove the entry at idx with backward-shift compaction so no tombstones
+// accumulate (linear-probing deletion; probe chains stay minimal).
+void remove_slot(Handle* h, uint64_t idx) {
+  uint64_t mask = h->hdr->mask;
+  uint64_t j = idx;
+  for (;;) {
+    h->entries[j].state = kEmpty;
+    uint64_t k = j;
+    for (;;) {
+      k = (k + 1) & mask;
+      Entry* ek = &h->entries[k];
+      if (ek->state == kEmpty) return;
+      uint64_t home = hash_id(ek->id) & mask;
+      // Entry at k stays iff its home lies circularly in (j, k].
+      bool stays = (j < k) ? (home > j && home <= k)
+                           : (home > j || home <= k);
+      if (stays) continue;
+      h->entries[j] = *ek;
+      lru_fixup_moved(h, k, j);
+      j = k;
+      break;
+    }
+  }
 }
 
 void drop_entry(Handle* h, uint64_t idx) {
@@ -239,10 +273,10 @@ void drop_entry(Handle* h, uint64_t idx) {
   if (e->lru_prev || e->lru_next || h->hdr->lru_head == idx + 1) {
     lru_unlink(h, idx + 1);
   }
-  free_data(h, e->offset, e->size);
-  e->state = kTombstone;
+  free_data(h, e->offset, e->alloc_size);
   e->refcount = 0;
   h->hdr->num_objects--;
+  remove_slot(h, idx);
 }
 
 // Evict LRU sealed objects with refcount==0 until `needed` bytes could fit.
@@ -390,17 +424,24 @@ int rtpu_create(void* hv, const uint8_t* id, uint64_t size,
   uint64_t slot = find_slot(h, id, &found);
   if (found != kNil) return RTPU_EXISTS;
   if (slot == kNil) return RTPU_FULL_TABLE;
-  uint64_t off = alloc_data(h, size);
+  uint64_t actual = 0;
+  uint64_t off = alloc_data(h, size, &actual);
   if (off == kNil) {
     if (!evict_for(h, align_up(size))) return RTPU_OOM;
-    off = alloc_data(h, size);
+    off = alloc_data(h, size, &actual);
     while (off == kNil && h->hdr->lru_head) {
       // Fragmentation: evict one more and retry.
       drop_entry(h, h->hdr->lru_head - 1);
       h->hdr->evictions++;
-      off = alloc_data(h, size);
+      off = alloc_data(h, size, &actual);
     }
     if (off == kNil) return RTPU_OOM;
+    // Eviction may have compacted the table; re-resolve our insert slot.
+    slot = find_slot(h, id, &found);
+    if (found != kNil || slot == kNil) {
+      free_data(h, off, actual);
+      return found != kNil ? RTPU_EXISTS : RTPU_FULL_TABLE;
+    }
   }
   Entry* e = &h->entries[slot];
   memcpy(e->id, id, kIdSize);
@@ -408,6 +449,7 @@ int rtpu_create(void* hv, const uint8_t* id, uint64_t size,
   e->refcount = 1;
   e->offset = off;
   e->size = size;
+  e->alloc_size = actual;
   e->lru_prev = e->lru_next = 0;
   h->hdr->num_objects++;
   h->hdr->created_total++;

@@ -1,0 +1,106 @@
+"""RemoteFunction: the object behind ``@ray_tpu.remote`` on a function.
+
+Role-equivalent to the reference's ``python/ray/remote_function.py:35``
+(``_remote`` :241): holds normalized submission options, exports the
+cloudpickled function to the GCS function store once
+(reference: _private/function_manager.py:181), and submits TaskSpecs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.task_spec import normalize_resources
+
+# Option defaults (reference: _private/ray_option_utils.py task_options).
+_TASK_DEFAULTS = dict(
+    num_cpus=None,
+    num_tpus=None,
+    num_gpus=None,
+    memory=None,
+    resources=None,
+    num_returns=1,
+    max_retries=3,
+    retry_exceptions=False,
+    name=None,
+    scheduling_strategy=None,
+    placement_group=None,
+    placement_group_bundle_index=-1,
+    runtime_env=None,
+    max_calls=0,
+    _metadata=None,
+)
+
+
+def _merge_options(base: Dict[str, Any], overrides: Dict[str, Any]):
+    out = dict(base)
+    for k, v in overrides.items():
+        if k not in _TASK_DEFAULTS:
+            raise ValueError(f"unknown task option: {k}")
+        out[k] = v
+    return out
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._function = fn
+        self._options = _merge_options(_TASK_DEFAULTS, options or {})
+        self._function_key: Optional[str] = None
+        self._exported_blob: Optional[bytes] = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._function.__name__}' cannot be called "
+            "directly; use '.remote()'.")
+
+    def options(self, **overrides) -> "RemoteFunction":
+        rf = RemoteFunction(self._function,
+                            _merge_options(self._options, overrides))
+        rf._exported_blob = self._exported_blob
+        return rf
+
+    def _ensure_exported(self, core) -> str:
+        if self._exported_blob is None:
+            self._exported_blob = cloudpickle.dumps(self._function)
+        return core.export_function(self._exported_blob)
+
+    def remote(self, *args, **kwargs):
+        core = worker_mod.require_worker()
+        o = self._options
+        key = self._ensure_exported(core)
+        resources = normalize_resources(
+            o["num_cpus"], o["num_tpus"], o["num_gpus"], o["memory"],
+            o["resources"], default_cpus=1.0)
+        strategy = o["scheduling_strategy"]
+        pg = o["placement_group"]
+        bundle_index = o["placement_group_bundle_index"]
+        if strategy is not None and hasattr(strategy, "placement_group"):
+            pg = strategy.placement_group
+            bundle_index = getattr(strategy, "placement_group_bundle_index",
+                                   -1)
+            strategy = None
+        refs = core.submit_task(
+            key, args, kwargs,
+            name=o["name"] or self._function.__name__,
+            num_returns=o["num_returns"],
+            resources=resources,
+            max_retries=o["max_retries"],
+            scheduling_strategy=strategy,
+            placement_group=pg,
+            placement_group_bundle_index=bundle_index,
+            runtime_env=o["runtime_env"],
+        )
+        if o["num_returns"] == 0:
+            return None
+        if o["num_returns"] == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def bound_function(self):
+        return self._function

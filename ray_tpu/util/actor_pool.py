@@ -1,0 +1,91 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+from ray_tpu._private import worker as worker_mod
+
+
+class ActorPool:
+    """Round-robins work over a fixed set of actors."""
+
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        if not self._idle:
+            raise ValueError("no idle actors; call get_next first")
+        actor = self._idle.pop(0)
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._index_to_future[self._next_task_index] = ref
+        self._next_task_index += 1
+
+    def has_next(self) -> bool:
+        return self._next_return_index < self._next_task_index
+
+    def get_next(self, timeout=None) -> Any:
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        core = worker_mod.require_worker()
+        value = core.get([ref], timeout=timeout)[0]
+        self._idle.append(self._future_to_actor.pop(ref))
+        return value
+
+    def get_next_unordered(self, timeout=None) -> Any:
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        core = worker_mod.require_worker()
+        refs = list(self._future_to_actor.keys())
+        ready, _ = core.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        for idx, fut in list(self._index_to_future.items()):
+            if fut == ref:
+                del self._index_to_future[idx]
+                if idx == self._next_return_index:
+                    while self._next_return_index not in \
+                            self._index_to_future and \
+                            self._next_return_index < self._next_task_index:
+                        self._next_return_index += 1
+                break
+        value = core.get([ref])[0]
+        self._idle.append(self._future_to_actor.pop(ref))
+        return value
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            if self._idle:
+                self.submit(fn, v)
+            else:
+                yield self.get_next()
+                self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            if self._idle:
+                self.submit(fn, v)
+            else:
+                yield self.get_next_unordered()
+                self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor) -> None:
+        self._idle.append(actor)

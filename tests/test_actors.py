@@ -1,0 +1,260 @@
+"""Actor tests (modelled on the reference's python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_basic_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote()) == 2
+
+
+def test_actor_constructor_args(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def __init__(self, a, b=10):
+            self.v = a + b
+
+        def get(self):
+            return self.v
+
+    assert ray_tpu.get(A.remote(1).get.remote()) == 11
+    assert ray_tpu.get(A.remote(1, b=2).get.remote()) == 3
+
+
+def test_actor_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.log = []
+
+        def add(self, x):
+            self.log.append(x)
+
+        def get(self):
+            return self.log
+
+    a = Appender.remote()
+    for i in range(20):
+        a.add.remote(i)
+    assert ray_tpu.get(a.get.remote()) == list(range(20))
+
+
+def test_actor_state_isolated(ray_start_regular):
+    @ray_tpu.remote
+    class C:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c1, c2 = C.remote(), C.remote()
+    ray_tpu.get(c1.incr.remote())
+    assert ray_tpu.get(c2.incr.remote()) == 1
+
+
+def test_actor_error(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def fail(self):
+            raise RuntimeError("method failed")
+
+        def ok(self):
+            return "fine"
+
+    a = A.remote()
+    with pytest.raises(RuntimeError, match="method failed"):
+        ray_tpu.get(a.fail.remote())
+    # actor survives method errors
+    assert ray_tpu.get(a.ok.remote()) == "fine"
+
+
+def test_actor_creation_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("ctor failed")
+
+        def m(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.m.remote(), timeout=20)
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def put(self, k, v):
+            self.v[k] = v
+
+        def get(self, k):
+            return self.v.get(k)
+
+    s = Store.options(name="kvstore").remote()
+    ray_tpu.get(s.put.remote("a", 1))
+    handle = ray_tpu.get_actor("kvstore")
+    assert ray_tpu.get(handle.get.remote("a")) == 1
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("missing")
+
+
+def test_duplicate_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    A.options(name="dup").remote()
+    time.sleep(0.1)
+    with pytest.raises(Exception):
+        h = A.options(name="dup").remote()
+        ray_tpu.get(h.m.remote(), timeout=10)
+
+
+def test_pass_handle_to_task(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.incr.remote())
+
+    c = Counter.remote()
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.incr.remote()) == 2
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.m.remote()) == 1
+    ray_tpu.kill(a)
+    time.sleep(0.3)
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(a.m.remote(), timeout=10)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Flaky:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            import os
+            os._exit(1)
+
+    f = Flaky.remote()
+    assert ray_tpu.get(f.incr.remote()) == 1
+    f.die.remote()
+    time.sleep(1.0)
+    # restarted: state reset
+    assert ray_tpu.get(f.incr.remote(), timeout=30) == 1
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, t):
+            import asyncio
+            await asyncio.sleep(t)
+            return t
+
+    w = AsyncWorker.remote()
+    ray_tpu.get(w.work.remote(0.0))  # warm: actor alive, route cached
+    t0 = time.time()
+    refs = [w.work.remote(0.3) for _ in range(5)]
+    assert ray_tpu.get(refs, timeout=30) == [0.3] * 5
+    # concurrent: should take ~0.3s, not 1.5s
+    assert time.time() - t0 < 1.2
+
+
+def test_threaded_actor(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Sleeper:
+        def nap(self, t):
+            time.sleep(t)
+            return t
+
+    s = Sleeper.remote()
+    ray_tpu.get(s.nap.remote(0.0))  # warm: actor alive, route cached
+    t0 = time.time()
+    ray_tpu.get([s.nap.remote(0.4) for _ in range(4)], timeout=30)
+    assert time.time() - t0 < 1.3
+
+
+def test_exit_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Quitter:
+        def quit(self):
+            from ray_tpu.actor import exit_actor
+            exit_actor()
+
+        def m(self):
+            return 1
+
+    q = Quitter.remote()
+    assert ray_tpu.get(q.m.remote()) == 1
+    ray_tpu.get(q.quit.remote(), timeout=10)
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(q.m.remote(), timeout=10)
+
+
+def test_actor_method_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    class M:
+        @ray_tpu.method(num_returns=2)
+        def two(self):
+            return 1, 2
+
+    m = M.remote()
+    a, b = m.two.remote()
+    assert ray_tpu.get([a, b]) == [1, 2]
+
+
+def test_actor_pool(ray_start_regular):
+    from ray_tpu.util import ActorPool
+
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]

@@ -1,0 +1,228 @@
+"""Core task/object API tests (modelled on the reference's
+python/ray/tests/test_basic.py suite)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_args_kwargs(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=2, *, c=3):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 6
+    assert ray_tpu.get(f.remote(1, 5, c=10)) == 16
+
+
+def test_put_get(ray_start_regular):
+    for value in [1, "hello", {"a": [1, 2]}, None, (1, 2)]:
+        assert ray_tpu.get(ray_tpu.put(value)) == value
+
+
+def test_put_get_numpy(ray_start_regular):
+    arr = np.random.rand(1000, 100)
+    out = ray_tpu.get(ray_tpu.put(arr))
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_object_ref_as_arg(ray_start_regular):
+    @ray_tpu.remote
+    def plus1(x):
+        return x + 1
+
+    ref = ray_tpu.put(10)
+    assert ray_tpu.get(plus1.remote(ref)) == 11
+
+
+def test_task_chain(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    ref = f.remote(0)
+    for _ in range(10):
+        ref = f.remote(ref)
+    assert ray_tpu.get(ref) == 11
+
+
+def test_nested_refs_not_resolved(ray_start_regular):
+    @ray_tpu.remote
+    def f(lst):
+        # nested refs arrive as ObjectRefs, not values
+        return [ray_tpu.get(r) for r in lst]
+
+    refs = [ray_tpu.put(i) for i in range(3)]
+    assert ray_tpu.get(f.remote(refs)) == [0, 1, 2]
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def f():
+        return 1, 2, 3
+
+    a, b, c = f.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_num_returns_zero(ray_start_regular):
+    @ray_tpu.remote(num_returns=0)
+    def f():
+        return None
+
+    assert f.remote() is None
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("expected failure")
+
+    with pytest.raises(ValueError, match="expected failure"):
+        ray_tpu.get(fail.remote())
+
+
+def test_error_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def fail():
+        raise KeyError("dep failed")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(Exception):
+        ray_tpu.get(consume.remote(fail.remote()))
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(5)) == 11
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast_ref = slow.remote(0.05)
+    slow_ref = slow.remote(10)
+    ready, not_ready = ray_tpu.wait([fast_ref, slow_ref], num_returns=1,
+                                    timeout=5)
+    assert ready == [fast_ref]
+    assert not_ready == [slow_ref]
+
+
+def test_wait_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(60)
+
+    ready, not_ready = ray_tpu.wait([hang.remote()], timeout=0.2)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(hang.remote(), timeout=0.3)
+
+
+def test_large_object(ray_start_regular):
+    arr = np.ones((4 << 20,), dtype=np.uint8)  # 4 MiB
+    out = ray_tpu.get(ray_tpu.put(arr))
+    assert out.nbytes == arr.nbytes
+
+
+def test_large_task_arg(ray_start_regular):
+    arr = np.ones((2 << 20,), dtype=np.uint8)  # 2 MiB, above inline limit
+
+    @ray_tpu.remote
+    def size_of(a):
+        return a.nbytes
+
+    assert ray_tpu.get(size_of.remote(arr)) == arr.nbytes
+
+
+def test_many_parallel_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(100)]
+    assert ray_tpu.get(refs) == [i * i for i in range(100)]
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote(num_returns=1)
+    def f():
+        return 1, 2
+
+    a, b = f.options(num_returns=2).remote()
+    assert ray_tpu.get(a) == 1 and ray_tpu.get(b) == 2
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+    nodes = ray_tpu.nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["Alive"]
+
+
+def test_runtime_context(ray_start_regular):
+    @ray_tpu.remote
+    def ctx_info():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_task_id(), ctx.get_node_id()
+
+    task_id, node_id = ray_tpu.get(ctx_info.remote())
+    assert task_id is not None
+    assert node_id == ray_tpu.nodes()[0]["NodeID"]
+
+
+def test_cancel(ray_start_regular):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(60)
+        return "done"
+
+    ref = hang.remote()
+    time.sleep(0.3)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(
+            (ray_tpu.exceptions.TaskCancelledError,
+             ray_tpu.exceptions.WorkerCrashedError,
+             ray_tpu.exceptions.RayActorError)):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_free_objects(ray_start_regular):
+    ref = ray_tpu.put("gone")
+    core = ray_tpu._private.worker.require_worker()
+    core.free([ref])
+    time.sleep(0.2)
+    assert not core.store.contains(ref.binary())
