@@ -515,7 +515,12 @@ class NodeManager:
                         w.killed_by_us = True
                         w.no_restart_kill = True
             elif mtype == "submit_actor_task":
+                # Ack after the spec is parked with the actor's worker (or
+                # handed to GCS for reroute) — from then on the worker-death
+                # / reroute paths own failure handling. The driver reparks
+                # and re-resolves if this ack never arrives.
                 self._on_submit_actor_task(payload)
+                conn.reply(msg_id, True)
             elif mtype == "fetch_object":
                 self._on_fetch_object(conn, payload, msg_id)
             elif mtype == "store_stats":

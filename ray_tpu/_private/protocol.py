@@ -15,6 +15,7 @@ chunks, the analog of the reference's object-manager Push RPC).
 
 from __future__ import annotations
 
+import collections
 import io
 import os
 import pickle
@@ -55,7 +56,6 @@ class Conn:
 
     def __init__(self, sock: socket.socket, handler=None, name: str = ""):
         self._sock = sock
-        self._send_lock = threading.Lock()
         self._handler = handler
         self._pending: Dict[int, "_Future"] = {}
         self._pending_lock = threading.Lock()
@@ -70,6 +70,25 @@ class Conn:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass  # unix sockets
+        # Sends go through a dedicated writer thread so a handler running on
+        # a receive loop never blocks on a full socket buffer (two peers both
+        # blocked in send() with full buffers = distributed deadlock; the
+        # reference avoids it with asio async writes, common/asio/).
+        self._send_q: collections.deque = collections.deque()
+        self._send_ev = threading.Event()
+        self._send_inflight = False
+        self._send_bytes = 0
+        self._send_cv = threading.Condition()
+        self._writer = threading.Thread(
+            target=self._write_loop, daemon=True, name=f"rtpu-send-{name}")
+        self._writer.start()
+
+    # Backpressure bound: senders block (briefly) once this much data is
+    # queued, so a wedged peer surfaces as slowness + eventual error rather
+    # than unbounded sender memory. Kept high enough that only bulk object
+    # transfer can hit it — control messages never will.
+    MAX_QUEUED_BYTES = 256 * 1024 * 1024
+    QUEUE_FULL_TIMEOUT = 60.0
 
     # -- sending --------------------------------------------------------------
 
@@ -83,17 +102,64 @@ class Conn:
         data = pickle.dumps((msg_id, reply_to, mtype, payload, is_error),
                             protocol=5)
         frame = _LEN.pack(len(data)) + data
-        with self._send_lock:
-            if self._closed:
+        if self._closed:
+            raise ConnectionClosed()
+        if self._send_bytes >= self.MAX_QUEUED_BYTES and \
+                threading.current_thread() is not self._writer:
+            with self._send_cv:
+                ok = self._send_cv.wait_for(
+                    lambda: self._closed
+                    or self._send_bytes < self.MAX_QUEUED_BYTES,
+                    timeout=self.QUEUE_FULL_TIMEOUT)
+            if not ok or self._closed:
                 raise ConnectionClosed()
-            try:
-                self._sock.sendall(frame)
-            except (BrokenPipeError, ConnectionResetError, OSError):
-                raise ConnectionClosed()
+        with self._send_cv:
+            self._send_bytes += len(frame)
+        self._send_q.append(frame)
+        self._send_ev.set()
+
+    def _write_loop(self):
+        while True:
+            self._send_ev.wait()
+            while True:
+                if not self._send_q:
+                    break
+                frame = self._send_q[0]  # pop only after the send completes,
+                self._send_inflight = True  # so flush() can't miss it
+                try:
+                    self._sock.sendall(frame)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self._send_inflight = False
+                    self.close()
+                    return
+                self._send_inflight = False
+                try:
+                    self._send_q.popleft()
+                except IndexError:
+                    pass
+                with self._send_cv:
+                    self._send_bytes = max(0, self._send_bytes - len(frame))
+                    self._send_cv.notify_all()
+            self._send_ev.clear()
+            if self._send_q:
+                self._send_ev.set()
+            elif self._closed:
+                return
 
     def notify(self, mtype: str, payload: Any = None) -> None:
         """Fire-and-forget message."""
         self._send(self._alloc_id(), None, mtype, payload)
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Best-effort wait until queued sends hit the socket (call before
+        process exit; daemon writer threads die with the process)."""
+        deadline = time.monotonic() + timeout
+        while (self._send_q or self._send_inflight) \
+                and time.monotonic() < deadline:
+            if self._closed:
+                return False
+            time.sleep(0.001)
+        return not self._send_q and not self._send_inflight
 
     def request_nowait(self, mtype: str, payload: Any = None) -> "_Future":
         fut = _Future()
@@ -158,6 +224,9 @@ class Conn:
         if self._closed:
             return
         self._closed = True
+        self._send_ev.set()  # wake the writer so it can exit
+        with self._send_cv:
+            self._send_cv.notify_all()  # wake blocked senders
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -184,20 +253,42 @@ class Conn:
 
 
 class _Future:
-    __slots__ = ("_ev", "_value", "_error")
+    __slots__ = ("_ev", "_value", "_error", "_cbs", "_cb_lock")
 
     def __init__(self):
         self._ev = threading.Event()
         self._value = None
         self._error = None
+        self._cbs: list = []
+        self._cb_lock = threading.Lock()
 
     def set(self, value):
         self._value = value
         self._ev.set()
+        self._fire_callbacks()
 
     def set_error(self, err):
         self._error = err
         self._ev.set()
+        self._fire_callbacks()
+
+    def add_done_callback(self, cb: Callable[["_Future"], None]):
+        """cb(self) runs when the result/error lands (immediately if it
+        already has). Runs on the conn's serve thread — keep it short."""
+        with self._cb_lock:
+            if not self._ev.is_set():
+                self._cbs.append(cb)
+                return
+        cb(self)
+
+    def _fire_callbacks(self):
+        with self._cb_lock:
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:
+                pass
 
     def done(self) -> bool:
         return self._ev.is_set()

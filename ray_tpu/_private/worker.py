@@ -581,27 +581,74 @@ class CoreWorker:
             else:
                 need_resolve = False
         if addr is not None:
-            try:
-                self.nm_conn(addr).notify("submit_actor_task", spec)
+            if self._send_actor_task_acked(addr, spec):
                 return
-            except protocol.ConnectionClosed:
-                with self._actor_lock:
-                    route["address"] = None
-                    route["pending"].append(spec)
-                    if not route["resolving"]:
-                        route["resolving"] = True
-                        need_resolve = True
+            # Connection already closed: park + re-resolve.
+            with self._actor_lock:
+                route["address"] = None
+                route["pending"].append(spec)
+                if not route["resolving"]:
+                    route["resolving"] = True
+                    need_resolve = True
         if need_resolve:
-            fut = self.gcs.request_nowait("resolve_actor", {"actor_id": aid})
+            self._resolve_actor_route(aid)
 
-            def on_done():
-                try:
-                    info = fut.result(timeout=None)
-                except BaseException:
-                    info = {"state": "DEAD", "node_address": None}
-                self._on_actor_resolved(aid, info)
+    def _send_actor_task_acked(self, addr: str, spec) -> bool:
+        """Submit an actor task to a node manager with an async delivery ack.
 
-            threading.Thread(target=on_done, daemon=True).start()
+        Sends ride a writer thread (protocol.py), so a dead peer no longer
+        raises synchronously from notify(); instead the NM acks each spec
+        once it has parked it with the actor's worker (at which point the
+        worker-death path owns failure handling). If the ack errors —
+        connection died with the spec possibly unsent — the spec is parked
+        and the route re-resolved, so the task is never silently dropped.
+        Returns False only if the connection was already closed at submit.
+        """
+        try:
+            conn = self.nm_conn(addr)
+            fut = conn.request_nowait("submit_actor_task", spec)
+        except (protocol.ConnectionClosed, ConnectionError, OSError):
+            return False
+
+        def on_ack(f):
+            try:
+                f.result(0)
+            except BaseException:
+                self._repark_actor_task(spec)
+
+        fut.add_done_callback(on_ack)
+        return True
+
+    def _make_submit_ack(self, spec):
+        def on_ack(f):
+            try:
+                f.result(0)
+            except BaseException:
+                self._repark_actor_task(spec)
+        return on_ack
+
+    def _repark_actor_task(self, spec):
+        aid = spec.actor_id.binary()
+        route = self._route_for(aid)
+        with self._actor_lock:
+            route["address"] = None
+            route["pending"].append(spec)
+            if route["resolving"]:
+                return
+            route["resolving"] = True
+        self._resolve_actor_route(aid)
+
+    def _resolve_actor_route(self, aid: bytes):
+        fut = self.gcs.request_nowait("resolve_actor", {"actor_id": aid})
+
+        def on_done(f):
+            try:
+                info = f.result(0)
+            except BaseException:
+                info = {"state": "DEAD", "node_address": None}
+            self._on_actor_resolved(aid, info)
+
+        fut.add_done_callback(on_done)
 
     def _on_actor_resolved(self, aid: bytes, info: dict):
         route = self._route_for(aid)
@@ -626,7 +673,8 @@ class CoreWorker:
             if conn is not None:
                 try:
                     for i, spec in enumerate(pending):
-                        conn.notify("submit_actor_task", spec)
+                        fut = conn.request_nowait("submit_actor_task", spec)
+                        fut.add_done_callback(self._make_submit_ack(spec))
                 except protocol.ConnectionClosed:
                     unsent = pending[i:]
                 else:

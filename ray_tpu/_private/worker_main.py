@@ -242,6 +242,7 @@ class WorkerExecutor:
                     "error": f"{type(e).__name__}: {e}\n{tb}"})
             except protocol.ConnectionClosed:
                 pass
+            self.nm.flush()
             os._exit(1)
         if spec.is_async:
             self._start_aio_loop(spec.max_concurrency)
@@ -294,9 +295,9 @@ class WorkerExecutor:
         threading.Thread(target=self._delayed_exit, daemon=True).start()
         return None
 
-    @staticmethod
-    def _delayed_exit():
+    def _delayed_exit(self):
         time.sleep(0.1)
+        self.nm.flush()
         os._exit(0)
 
     def _execute_actor_task(self, spec: ActorTaskSpec):

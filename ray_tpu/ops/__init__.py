@@ -1,0 +1,16 @@
+"""TPU compute ops: attention kernels, collectives-based long-context ops.
+
+The reference framework has no sequence-parallel or attention code at all
+(SURVEY.md §2.3: ring attention / context parallelism ABSENT — delegated to
+libraries running on top). Here they are first-class: long-context scaling
+shapes the core design on TPU, where a context-parallel mesh axis turns
+attention into a ring of ICI ``ppermute`` steps.
+"""
+
+from ray_tpu.ops.attention import (  # noqa: F401
+    mha_reference,
+    ring_attention,
+    ring_attention_sharded,
+)
+
+__all__ = ["mha_reference", "ring_attention", "ring_attention_sharded"]

@@ -1,0 +1,152 @@
+"""Attention ops: reference MHA and ring attention (context parallelism).
+
+Ring attention implements blockwise-parallel attention over a
+sequence-sharded mesh axis: each device holds a contiguous sequence shard
+of Q/K/V; K/V shards rotate around the ring via ``lax.ppermute`` (one ICI
+hop per step) while each device accumulates its queries' attention with a
+numerically-stable online softmax. After ``axis_size`` steps every query
+has attended to the full sequence without any device ever materializing
+the full K/V — memory per chip stays O(L/N), compute overlaps with the
+ICI transfer of the next shard.
+
+No counterpart exists in the reference (SURVEY.md §5 "Long-context /
+sequence parallelism: Absent") — this is new TPU-first work, following the
+blockwise-attention recipe from the ring-attention literature (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30  # big-but-finite so exp() underflows cleanly, no NaN via inf-inf
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain einsum multi-head attention. Shapes [B, L, H, D]."""
+    *_, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qi = jnp.arange(lq)[:, None]
+        kj = jnp.arange(lk)[None, :]
+        logits = jnp.where(kj <= qi, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _block_attn_accum(q, k, v, carry, q_offset, k_offset, scale, causal):
+    """One blockwise-attention accumulation step (online softmax).
+
+    carry = (numerator [B,Lq,H,D] f32, denominator [B,H,Lq] f32,
+    running max [B,H,Lq] f32); offsets are *global* sequence positions of
+    the first query / key row, used for causal masking across ring steps.
+    """
+    num, den, m = carry
+    lq, lk = q.shape[1], k.shape[1]
+
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k,
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = q_offset + jnp.arange(lq)[:, None]
+        kj = k_offset + jnp.arange(lk)[None, :]
+        s = jnp.where(kj <= qi, s, _NEG_INF)
+
+    m_block = jnp.max(s, axis=-1)                      # [B,H,Lq]
+    m_new = jnp.maximum(m, m_block)
+    # Rescale previous accumulators to the new max.
+    alpha = jnp.exp(m - m_new)                         # [B,H,Lq]
+    p = jnp.exp(s - m_new[..., None])                  # [B,H,Lq,Lk]
+    num = num * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    den = den * alpha + jnp.sum(p, axis=-1)
+    return num, den, m_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention body — call inside ``shard_map`` with the sequence
+    dimension sharded over ``axis_name``. Shapes are per-shard [B, L/N, H, D].
+    """
+    b, l_shard, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    # Ring: shard s moves to device (s+1) — after step t, this device holds
+    # the K/V shard originally on (my_idx - t) mod N.
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    q_offset = my_idx * l_shard
+
+    def step(t, carry):
+        kv, acc = carry
+        k_t, v_t = kv
+        src = (my_idx - t) % axis_size
+        acc = _block_attn_accum(
+            q, k_t, v_t, acc, q_offset, src * l_shard, scale, causal)
+        kv = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), kv)
+        return kv, acc
+
+    acc0 = (
+        jnp.zeros((b, l_shard, h, d), jnp.float32),
+        jnp.zeros((b, h, l_shard), jnp.float32),
+        jnp.full((b, h, l_shard), _NEG_INF, jnp.float32),
+    )
+    (_, (num, den, _)) = lax.fori_loop(
+        0, axis_size, step, ((k, v), acc0))
+    out = num / den.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("axis_name", "causal", "mesh"))
+def _ring_attention_jit(q, k, v, mesh, axis_name, causal):
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+) -> jax.Array:
+    """Top-level entry: shard [B, L, H, D] inputs over ``axis_name`` on the
+    sequence dim and run ring attention. L must divide evenly by the axis
+    size."""
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by {axis_name}={n}")
+    return _ring_attention_jit(q, k, v, mesh, axis_name, causal)

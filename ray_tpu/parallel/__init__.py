@@ -1,0 +1,41 @@
+"""TPU parallelism layer: device meshes, sharding rules, collectives.
+
+This package is the TPU-native replacement for the reference's
+``python/ray/util/collective`` (NCCL/Gloo process groups) and the parallel
+strategies hosted on it (DDP/FSDP wrappers in ``train/torch/train_loop_utils.py``).
+Instead of flat NCCL ranks, the unit of parallelism is a
+``jax.sharding.Mesh`` over TPU chips: collectives are compiled XLA programs
+riding ICI (``psum`` / ``all_gather`` / ``ppermute`` under ``shard_map``),
+and model parallelism is expressed as logical-axis sharding rules consumed
+by ``jit``.
+"""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    make_mesh,
+    mesh_shape_for,
+    topology_info,
+    best_mesh_axes,
+)
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    AxisRules,
+    logical_sharding,
+    shard_pytree,
+    with_logical_constraint,
+    DEFAULT_RULES,
+)
+from ray_tpu.parallel import collective  # noqa: F401
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "mesh_shape_for",
+    "topology_info",
+    "best_mesh_axes",
+    "AxisRules",
+    "logical_sharding",
+    "shard_pytree",
+    "with_logical_constraint",
+    "DEFAULT_RULES",
+    "collective",
+]

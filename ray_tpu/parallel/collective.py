@@ -1,0 +1,512 @@
+"""Collective communication API.
+
+Shape-compatible with the reference's ``ray.util.collective``
+(``util/collective/collective.py``: init_collective_group :120, allreduce
+:258, barrier :298, broadcast :373, allgather :423, reducescatter :472,
+send/recv :531,:594) with TPU-native backends instead of NCCL/Gloo:
+
+- ``xla``   — the group is a set of local ``jax.Device``s; every collective
+  is a compiled ``shard_map`` program over a 1-D ``ranks`` mesh, so the
+  traffic rides ICI exactly as XLA schedules it. This replaces the
+  reference's ``NCCLGroup`` (``collective_group/nccl_collective_group.py:127``).
+- ``store`` — cross-process functional backend: ranks exchange object-store
+  refs through a named coordinator actor (the analog of the reference's
+  named-actor NCCL-UID rendezvous) and reduce locally. This replaces
+  ``GLOOGroup`` (``collective_group/gloo_collective_group.py:184``) as the
+  always-available CPU/control-plane path (DCN-ish).
+
+The ``BaseGroup`` plug-point mirrors
+``collective_group/base_collective_group.py:15``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from enum import Enum
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ReduceOp(Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    AVG = "avg"
+
+
+class Backend(str, Enum):
+    XLA = "xla"
+    STORE = "store"
+
+
+_groups: Dict[str, "BaseGroup"] = {}
+_groups_lock = threading.Lock()
+
+DEFAULT_GROUP_NAME = "default"
+
+
+class BaseGroup:
+    """Interface every collective backend implements."""
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+
+    # Each op takes/returns host or jax arrays; list-valued ops are
+    # rank-major.
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        raise NotImplementedError
+
+    def barrier(self):
+        raise NotImplementedError
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        raise NotImplementedError
+
+    def allgather(self, tensor):
+        raise NotImplementedError
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        raise NotImplementedError
+
+    def send(self, tensor, dst_rank: int):
+        raise NotImplementedError
+
+    def recv(self, shape, dtype, src_rank: int):
+        raise NotImplementedError
+
+    def destroy(self):
+        pass
+
+
+# --------------------------------------------------------------------- xla
+
+
+class XlaGroup(BaseGroup):
+    """In-process device-mesh group: rank i == device i.
+
+    Collectives take a list of ``world_size`` arrays (one per rank, like the
+    reference's ``*_multigpu`` variants) and run as one compiled shard_map
+    program; results come back as a list. Compiled programs are cached per
+    (op, shape, dtype).
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str,
+                 devices: Optional[Sequence] = None):
+        super().__init__(world_size, rank, group_name)
+        import jax
+
+        devs = list(devices if devices is not None else jax.devices())
+        if len(devs) < world_size:
+            raise ValueError(
+                f"xla group needs {world_size} devices, have {len(devs)}")
+        self.devices = devs[:world_size]
+        from jax.sharding import Mesh
+
+        self.mesh = Mesh(np.asarray(self.devices), ("ranks",))
+        self._cache: Dict[Any, Any] = {}
+
+    # -- helpers
+    def _stack(self, tensors: List[Any]):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if len(tensors) != self.world_size:
+            raise ValueError(
+                f"need {self.world_size} tensors, got {len(tensors)}")
+        x = jnp.stack([jnp.asarray(t) for t in tensors])
+        return jax.device_put(x, NamedSharding(self.mesh, P("ranks")))
+
+    def _compiled(self, key, builder):
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = builder()
+            self._cache[key] = fn
+        return fn
+
+    def allreduce(self, tensors: List[Any], op: ReduceOp = ReduceOp.SUM):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        x = self._stack(tensors)
+        key = ("allreduce", op, x.shape, x.dtype)
+
+        def build():
+            def body(s):
+                if op == ReduceOp.SUM:
+                    return lax.psum(s, "ranks")
+                if op == ReduceOp.AVG:
+                    return lax.pmean(s, "ranks")
+                if op == ReduceOp.MAX:
+                    return lax.pmax(s, "ranks")
+                if op == ReduceOp.MIN:
+                    return lax.pmin(s, "ranks")
+                # PRODUCT: gather then reduce on-chip (no native pprod).
+                g = lax.all_gather(s, "ranks", axis=0, tiled=True)
+                return jnp.prod(g, axis=0, keepdims=True)
+
+            return jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=P("ranks"),
+                out_specs=P("ranks")))
+
+        out = self._compiled(key, build)(x)
+        return list(out)
+
+    def allgather(self, tensors: List[Any]):
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        x = self._stack(tensors)
+        key = ("allgather", x.shape, x.dtype)
+
+        def build():
+            def body(s):
+                return lax.all_gather(s, "ranks", axis=0, tiled=True)
+
+            return jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=P("ranks"), out_specs=P(),
+                check_vma=False))
+
+        out = self._compiled(key, build)(x)
+        return [out for _ in range(self.world_size)]
+
+    def reducescatter(self, tensors: List[Any], op: ReduceOp = ReduceOp.SUM):
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        x = self._stack(tensors)  # (W, n, ...) with n % W == 0
+        if x.shape[1] % self.world_size:
+            raise ValueError(
+                f"reducescatter dim {x.shape[1]} not divisible by "
+                f"world size {self.world_size}")
+        key = ("reducescatter", op, x.shape, x.dtype)
+
+        def build():
+            def body(s):
+                r = lax.psum_scatter(
+                    s[0], "ranks", scatter_dimension=0, tiled=True)
+                if op == ReduceOp.AVG:
+                    r = r / self.world_size
+                return r[None]
+
+            return jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=P("ranks"),
+                out_specs=P("ranks")))
+
+        if op not in (ReduceOp.SUM, ReduceOp.AVG):
+            raise NotImplementedError(f"reducescatter op {op}")
+        out = self._compiled(key, build)(x)
+        return list(out)
+
+    def broadcast(self, tensors: List[Any], src_rank: int = 0):
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        x = self._stack(tensors)
+        key = ("broadcast", src_rank, x.shape, x.dtype)
+
+        def build():
+            def body(s):
+                g = lax.all_gather(s, "ranks", axis=0, tiled=True)
+                return g[src_rank][None]
+
+            return jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=P("ranks"),
+                out_specs=P("ranks")))
+
+        out = self._compiled(key, build)(x)
+        return list(out)
+
+    def permute(self, tensors: List[Any], perm: List[tuple]):
+        """ppermute — the primitive under ring algorithms."""
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        x = self._stack(tensors)
+        key = ("permute", tuple(perm), x.shape, x.dtype)
+
+        def build():
+            def body(s):
+                return lax.ppermute(s, "ranks", perm=perm)
+
+            return jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=P("ranks"),
+                out_specs=P("ranks")))
+
+        out = self._compiled(key, build)(x)
+        return list(out)
+
+    def barrier(self):
+        import jax.numpy as jnp
+
+        self.allreduce([jnp.zeros((1,)) for _ in range(self.world_size)])
+
+
+# -------------------------------------------------------------------- store
+
+
+_COORD_NAME_FMT = "_rtpu_collective_coord:{}"
+
+
+class _Coordinator:
+    """Named rendezvous/mailbox actor (one per group).
+
+    Non-blocking: ranks contribute refs and poll for completion, so the
+    actor's serial execution loop never stalls.
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._slots: Dict[str, dict] = {}
+        self._mail: Dict[str, Any] = {}
+
+    def contribute(self, key: str, rank: int, value):
+        slot = self._slots.setdefault(key, {"vals": {}, "taken": set()})
+        slot["vals"][rank] = value
+        return len(slot["vals"])
+
+    def collect(self, key: str, rank: int):
+        """Return all contributions once complete; the slot is freed after
+        every rank has collected (prevents unbounded growth in long loops)."""
+        slot = self._slots.get(key)
+        if slot is None or len(slot["vals"]) < self.world_size:
+            return None
+        vals = [slot["vals"][r] for r in range(self.world_size)]
+        slot["taken"].add(rank)
+        if len(slot["taken"]) >= self.world_size:
+            self._slots.pop(key, None)
+        return vals
+
+    def post(self, key: str, value):
+        self._mail[key] = value
+        return True
+
+    def take(self, key: str):
+        return self._mail.pop(key, None)
+
+
+class StoreGroup(BaseGroup):
+    """Cross-process group over the object store (functional path)."""
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        import ray_tpu
+
+        self._seq = 0
+        # p2p sequence numbers are per (src, dst) channel — sender and
+        # receiver each count that channel's ops, so unrelated ops on either
+        # endpoint can't desync the rendezvous keys.
+        self._p2p_seq: Dict[tuple, int] = {}
+        name = _COORD_NAME_FMT.format(group_name)
+        if rank == 0:
+            coord_cls = ray_tpu.remote(_Coordinator)
+            try:
+                self._coord = coord_cls.options(
+                    name=name, lifetime="detached").remote(world_size)
+            except Exception:
+                self._coord = ray_tpu.get_actor(name)
+        else:
+            deadline = time.time() + 60.0
+            while True:
+                try:
+                    self._coord = ray_tpu.get_actor(name)
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"collective group '{group_name}' rendezvous "
+                            f"timed out waiting for rank 0")
+                    time.sleep(0.05)
+
+    def _exchange(self, tag: str, value) -> List[Any]:
+        import ray_tpu
+
+        self._seq += 1
+        key = f"{tag}:{self._seq}"
+        ray_tpu.get(self._coord.contribute.remote(key, self.rank, value))
+        deadline = time.time() + 300.0
+        while True:
+            vals = ray_tpu.get(self._coord.collect.remote(key, self.rank))
+            if vals is not None:
+                return vals
+            if time.time() > deadline:
+                raise TimeoutError(f"collective op {tag} timed out")
+            time.sleep(0.002)
+
+    @staticmethod
+    def _reduce(arrs: List[np.ndarray], op: ReduceOp) -> np.ndarray:
+        stack = np.stack([np.asarray(a) for a in arrs])
+        if op == ReduceOp.SUM:
+            return stack.sum(axis=0)
+        if op == ReduceOp.AVG:
+            return stack.mean(axis=0)
+        if op == ReduceOp.MAX:
+            return stack.max(axis=0)
+        if op == ReduceOp.MIN:
+            return stack.min(axis=0)
+        if op == ReduceOp.PRODUCT:
+            return stack.prod(axis=0)
+        raise NotImplementedError(op)
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        vals = self._exchange("allreduce", np.asarray(tensor))
+        return self._reduce(vals, op)
+
+    def allgather(self, tensor):
+        vals = self._exchange("allgather", np.asarray(tensor))
+        return np.stack(vals)
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        t = np.asarray(tensor)
+        if t.shape[0] % self.world_size:
+            raise ValueError("reducescatter dim not divisible by world size")
+        vals = self._exchange("reducescatter", t)
+        full = self._reduce(vals, op)
+        chunk = t.shape[0] // self.world_size
+        return full[self.rank * chunk:(self.rank + 1) * chunk]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        payload = np.asarray(tensor) if self.rank == src_rank else None
+        vals = self._exchange("broadcast", payload)
+        return vals[src_rank]
+
+    def barrier(self):
+        self._exchange("barrier", None)
+
+    def send(self, tensor, dst_rank: int):
+        import ray_tpu
+
+        chan = (self.rank, dst_rank)
+        seq = self._p2p_seq.get(chan, 0) + 1
+        self._p2p_seq[chan] = seq
+        key = f"p2p:{self.rank}->{dst_rank}:{seq}"
+        ray_tpu.get(self._coord.post.remote(key, np.asarray(tensor)))
+
+    def recv(self, shape, dtype, src_rank: int):
+        import ray_tpu
+
+        chan = (src_rank, self.rank)
+        seq = self._p2p_seq.get(chan, 0) + 1
+        self._p2p_seq[chan] = seq
+        key = f"p2p:{src_rank}->{self.rank}:{seq}"
+        deadline = time.time() + 300.0
+        while True:
+            val = ray_tpu.get(self._coord.take.remote(key))
+            if val is not None:
+                return np.asarray(val, dtype=dtype).reshape(shape)
+            if time.time() > deadline:
+                raise TimeoutError("recv timed out")
+            time.sleep(0.002)
+
+    def destroy(self):
+        import ray_tpu
+
+        if self.rank == 0:
+            try:
+                ray_tpu.kill(self._coord)
+            except Exception:
+                pass
+
+
+# ----------------------------------------------------------------- module API
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "xla",
+    group_name: str = DEFAULT_GROUP_NAME,
+    devices: Optional[Sequence] = None,
+) -> BaseGroup:
+    """Create (or join) a collective group. Reference: collective.py:120."""
+    backend = Backend(backend)
+    # Reserve the name under one lock acquisition so two concurrent
+    # initializers can't both construct and silently clobber each other.
+    with _groups_lock:
+        if group_name in _groups:
+            raise RuntimeError(f"group '{group_name}' already initialized")
+        _groups[group_name] = None  # reservation
+    try:
+        if backend == Backend.XLA:
+            g: BaseGroup = XlaGroup(
+                world_size, rank, group_name, devices=devices)
+        else:
+            g = StoreGroup(world_size, rank, group_name)
+    except BaseException:
+        with _groups_lock:
+            _groups.pop(group_name, None)
+        raise
+    with _groups_lock:
+        _groups[group_name] = g
+    return g
+
+
+def is_group_initialized(group_name: str = DEFAULT_GROUP_NAME) -> bool:
+    with _groups_lock:
+        return _groups.get(group_name) is not None
+
+
+def get_group(group_name: str = DEFAULT_GROUP_NAME) -> BaseGroup:
+    with _groups_lock:
+        g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group '{group_name}' is not initialized")
+    return g
+
+
+def destroy_collective_group(group_name: str = DEFAULT_GROUP_NAME):
+    with _groups_lock:
+        g = _groups.pop(group_name, None)
+    if g is not None:
+        g.destroy()
+
+
+def get_rank(group_name: str = DEFAULT_GROUP_NAME) -> int:
+    return get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = DEFAULT_GROUP_NAME) -> int:
+    return get_group(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = DEFAULT_GROUP_NAME,
+              op: ReduceOp = ReduceOp.SUM):
+    return get_group(group_name).allreduce(tensor, op=op)
+
+
+def allgather(tensor, group_name: str = DEFAULT_GROUP_NAME):
+    return get_group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = DEFAULT_GROUP_NAME,
+                  op: ReduceOp = ReduceOp.SUM):
+    return get_group(group_name).reducescatter(tensor, op=op)
+
+
+def broadcast(tensor, src_rank: int = 0,
+              group_name: str = DEFAULT_GROUP_NAME):
+    return get_group(group_name).broadcast(tensor, src_rank=src_rank)
+
+
+def barrier(group_name: str = DEFAULT_GROUP_NAME):
+    return get_group(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = DEFAULT_GROUP_NAME):
+    return get_group(group_name).send(tensor, dst_rank)
+
+
+def recv(shape, dtype, src_rank: int, group_name: str = DEFAULT_GROUP_NAME):
+    return get_group(group_name).recv(shape, dtype, src_rank)

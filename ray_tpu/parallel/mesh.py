@@ -1,0 +1,151 @@
+"""Device-mesh construction and TPU topology discovery.
+
+Role-equivalent to the reference's NCCL communicator setup
+(``util/collective/collective_group/nccl_collective_group.py:127``) but
+TPU-first: the communicator object *is* a ``jax.sharding.Mesh``, built so
+that collectives over the innermost axes ride ICI. Axis order matters on
+TPU — ``mesh_utils.create_device_mesh`` lays later mesh axes along
+physically adjacent chips, so we always order axes
+(dp, fsdp, pp, sp, tp): tensor-parallel traffic (highest volume, per-layer)
+gets the tightest rings, data-parallel (lowest volume, per-step) spans DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost (slowest / DCN-friendly) first.
+AXIS_ORDER: Tuple[str, ...] = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes of each parallelism axis. ``-1`` on at most one axis means
+    "absorb all remaining devices" (like torch's DeviceMesh -1)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes ({fixed})")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return MeshConfig(**sizes)
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def nontrivial(self) -> Dict[str, int]:
+        return {a: s for a, s in self.axis_sizes().items() if s > 1}
+
+
+def topology_info(devices: Optional[Sequence[jax.Device]] = None) -> dict:
+    """Describe the attached accelerator topology.
+
+    Fills the role of the reference's GPU autodetect
+    (``_private/resource_spec.py``) which had no TPU support at all
+    (``util/accelerators/accelerators.py:1-7`` lists only NVIDIA types).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    d0 = devices[0]
+    info = {
+        "platform": d0.platform,
+        "device_kind": getattr(d0, "device_kind", "unknown"),
+        "num_devices": len(devices),
+        "num_hosts": len({d.process_index for d in devices}),
+        "coords": None,
+    }
+    coords = getattr(d0, "coords", None)
+    if coords is not None:
+        try:
+            all_coords = [tuple(d.coords) for d in devices]
+            dims = tuple(
+                max(c[i] for c in all_coords) + 1 for i in range(len(coords)))
+            info["coords"] = dims
+        except Exception:
+            pass
+    return info
+
+
+def best_mesh_axes(n_devices: int, model_parallel: int = 1) -> MeshConfig:
+    """Heuristic default: put ``model_parallel`` on tp (innermost, ICI-dense),
+    the rest on dp."""
+    if n_devices % model_parallel:
+        raise ValueError(
+            f"{n_devices} devices not divisible by tp={model_parallel}")
+    return MeshConfig(dp=n_devices // model_parallel, tp=model_parallel)
+
+
+def mesh_shape_for(config: MeshConfig, n_devices: int) -> Tuple[Tuple[str, int], ...]:
+    resolved = config.resolve(n_devices)
+    return tuple((a, getattr(resolved, a)) for a in AXIS_ORDER)
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axes: Optional[Dict[str, int]] = None,
+    keep_trivial: bool = False,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with ICI-aware device placement.
+
+    ``axes`` is a convenience dict form ({"dp": 2, "tp": 4}); unlisted axes
+    default to 1. Trivial (size-1) axes are dropped unless ``keep_trivial``
+    so PartitionSpecs stay short; pass ``keep_trivial=True`` when a spec
+    names every axis (e.g. the graft dryrun).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if config is None:
+        cfg_axes = dict(axes or {})
+        config = MeshConfig(**{a: cfg_axes.get(a, 1) for a in AXIS_ORDER})
+    config = config.resolve(len(devices))
+
+    sizes = config.axis_sizes()
+    if not keep_trivial:
+        sizes = {a: s for a, s in sizes.items() if s > 1} or {"dp": 1}
+    names = tuple(sizes.keys())
+    shape = tuple(sizes.values())
+
+    if math.prod(shape) != len(devices):
+        raise ValueError(f"mesh {sizes} != {len(devices)} devices")
+
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=devices, allow_split_physical_axes=True)
+    except Exception:
+        # Fallback for platforms without topology info (CPU test meshes).
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def host_mesh_devices(mesh: Mesh) -> List[jax.Device]:
+    """Devices of ``mesh`` driven by this host process (for per-host
+    data feeding in multi-host SPMD)."""
+    pid = jax.process_index()
+    return [d for d in mesh.devices.flat if d.process_index == pid]

@@ -1,19 +1,25 @@
 """Test configuration.
 
 JAX must run on a virtual 8-device CPU mesh for all tests (the TPU tunnel is
-single-chip; sharding tests need a mesh), so set the platform flags before
-jax is ever imported.
+single-chip; sharding tests need a mesh). The environment pre-imports jax via
+a sitecustomize hook, so env vars set here are too late for jax's import-time
+config read — instead we switch the platform with ``jax.config.update`` before
+any backend is initialized, which jax honors until first device use.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+# Subprocesses (workers) read these at interpreter start.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -36,3 +42,9 @@ def ray_start_2_cpus():
     ctx = ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
     yield ctx
     ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    """The 8 virtual CPU devices standing in for one TPU slice."""
+    return jax.devices("cpu")

@@ -1,0 +1,205 @@
+"""Tests for ray_tpu.parallel: mesh construction, sharding rules,
+collective ops (xla + store backends) on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel import (
+    MeshConfig,
+    make_mesh,
+    topology_info,
+    AxisRules,
+    DEFAULT_RULES,
+    shard_pytree,
+    collective,
+)
+from ray_tpu.parallel.collective import ReduceOp
+
+
+# ------------------------------------------------------------------- mesh
+
+
+def test_mesh_config_resolve():
+    cfg = MeshConfig(dp=-1, tp=2).resolve(8)
+    assert cfg.dp == 4 and cfg.tp == 2
+    with pytest.raises(ValueError):
+        MeshConfig(dp=3, tp=2).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(dp=-1, tp=-1).resolve(8)
+
+
+def test_make_mesh_drops_trivial_axes():
+    mesh = make_mesh(axes={"dp": 4, "tp": 2})
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.devices.shape == (4, 2)
+    mesh2 = make_mesh(axes={"dp": 8, "tp": 1})
+    assert mesh2.axis_names == ("dp",)
+
+
+def test_make_mesh_keep_trivial():
+    mesh = make_mesh(axes={"dp": 8}, keep_trivial=True)
+    assert mesh.axis_names == ("dp", "fsdp", "pp", "ep", "sp", "tp")
+    assert mesh.devices.shape == (8, 1, 1, 1, 1, 1)
+
+
+def test_topology_info():
+    info = topology_info()
+    assert info["num_devices"] == 8
+    assert info["num_hosts"] == 1
+
+
+# --------------------------------------------------------------- sharding
+
+
+def test_axis_rules_spec_and_sharding():
+    rules = AxisRules(batch=("dp", "fsdp"), embed="fsdp", mlp="tp")
+    mesh = make_mesh(axes={"dp": 2, "fsdp": 2, "tp": 2})
+    sh = rules.sharding(mesh, "batch", None, "mlp")
+    from jax.sharding import PartitionSpec as P
+
+    assert sh.spec == P(("dp", "fsdp"), None, "tp")
+    # Rules naming absent mesh axes degrade to replication on that dim.
+    mesh_dp = make_mesh(axes={"dp": 8})
+    sh2 = rules.sharding(mesh_dp, "batch", "mlp")
+    assert sh2.spec == P(("dp",), None)
+
+
+def test_shard_pytree():
+    mesh = make_mesh(axes={"dp": 4, "tp": 2})
+    tree = {"w": np.ones((8, 4), np.float32), "b": np.zeros((4,), np.float32)}
+    axes = {"w": ("batch", "mlp"), "b": None}
+    rules = AxisRules(batch="dp", mlp="tp")
+    out = shard_pytree(tree, mesh, axes, rules)
+    assert out["w"].sharding.spec == jax.sharding.PartitionSpec(("dp",), ("tp",))
+    np.testing.assert_array_equal(np.asarray(out["w"]), tree["w"])
+
+
+# ------------------------------------------------------------- collectives
+
+
+@pytest.fixture
+def xla_group():
+    g = collective.init_collective_group(
+        world_size=8, rank=0, backend="xla", group_name="test_xla")
+    yield g
+    collective.destroy_collective_group("test_xla")
+
+
+def test_xla_allreduce(xla_group):
+    tensors = [np.full((4,), float(i)) for i in range(8)]
+    out = xla_group.allreduce(tensors)
+    expected = np.full((4,), float(sum(range(8))))
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), expected)
+
+
+def test_xla_allreduce_ops(xla_group):
+    tensors = [np.full((2, 2), float(i + 1)) for i in range(8)]
+    out_max = xla_group.allreduce(tensors, op=ReduceOp.MAX)
+    np.testing.assert_allclose(np.asarray(out_max[0]), 8.0)
+    out_min = xla_group.allreduce(tensors, op=ReduceOp.MIN)
+    np.testing.assert_allclose(np.asarray(out_min[3]), 1.0)
+    out_avg = xla_group.allreduce(tensors, op=ReduceOp.AVG)
+    np.testing.assert_allclose(np.asarray(out_avg[0]), 4.5)
+
+
+def test_xla_allgather(xla_group):
+    tensors = [np.full((3,), float(i)) for i in range(8)]
+    out = xla_group.allgather(tensors)
+    assert np.asarray(out[0]).shape == (8 * 3,) or np.asarray(out[0]).shape == (8, 3) or np.asarray(out[0]).shape[0] == 24
+
+
+def test_xla_reducescatter(xla_group):
+    tensors = [np.arange(8.0) for _ in range(8)]
+    out = xla_group.reducescatter(tensors)
+    for r, o in enumerate(out):
+        np.testing.assert_allclose(np.asarray(o).ravel(), [8.0 * r])
+
+
+def test_xla_broadcast(xla_group):
+    tensors = [np.full((2,), float(i)) for i in range(8)]
+    out = xla_group.broadcast(tensors, src_rank=3)
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), 3.0)
+
+
+def test_xla_permute_ring(xla_group):
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    tensors = [np.full((1,), float(i)) for i in range(8)]
+    out = xla_group.permute(tensors, perm)
+    for i in range(8):
+        np.testing.assert_allclose(np.asarray(out[(i + 1) % 8]), float(i))
+
+
+def test_module_level_api():
+    collective.init_collective_group(4, 0, backend="xla", group_name="mod")
+    try:
+        assert collective.is_group_initialized("mod")
+        assert collective.get_rank("mod") == 0
+        assert collective.get_collective_group_size("mod") == 4
+        out = collective.allreduce(
+            [np.ones(2) for _ in range(4)], group_name="mod")
+        np.testing.assert_allclose(np.asarray(out[0]), 4.0)
+    finally:
+        collective.destroy_collective_group("mod")
+    assert not collective.is_group_initialized("mod")
+
+
+# store backend needs a running cluster
+def _store_worker(rank, world, results):
+    g = collective.StoreGroup(world, rank, "store_test")
+    r = g.allreduce(np.full((4,), float(rank + 1)))
+    ag = g.allgather(np.full((2,), float(rank)))
+    rs = g.reducescatter(np.arange(float(world * 2)))
+    bc = g.broadcast(np.full((2,), 7.0) if rank == 1 else None, src_rank=1)
+    g.barrier()
+    results[rank] = (r, ag, rs, bc)
+
+
+def test_store_backend_collectives(ray_start_regular):
+    import threading
+
+    world = 3
+    results = {}
+    threads = [
+        threading.Thread(target=_store_worker, args=(r, world, results))
+        for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(results) == world
+    for rank in range(world):
+        r, ag, rs, bc = results[rank]
+        np.testing.assert_allclose(r, 6.0)  # 1+2+3
+        np.testing.assert_allclose(ag, np.stack(
+            [np.full((2,), float(i)) for i in range(world)]))
+        chunk = 2
+        np.testing.assert_allclose(
+            rs, 3.0 * np.arange(float(world * 2))[rank * chunk:(rank + 1) * chunk])
+        np.testing.assert_allclose(bc, 7.0)
+
+
+def test_store_send_recv(ray_start_regular):
+    import threading
+
+    out = {}
+
+    def sender():
+        g = collective.StoreGroup(2, 0, "p2p_test")
+        g.send(np.arange(6.0).reshape(2, 3), dst_rank=1)
+
+    def receiver():
+        g = collective.StoreGroup(2, 1, "p2p_test")
+        out["v"] = g.recv((2, 3), np.float64, src_rank=0)
+
+    ts = [threading.Thread(target=sender), threading.Thread(target=receiver)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    np.testing.assert_allclose(out["v"], np.arange(6.0).reshape(2, 3))
