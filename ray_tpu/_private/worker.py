@@ -16,6 +16,7 @@ fetch RPC).
 from __future__ import annotations
 
 import atexit
+import concurrent.futures
 import hashlib
 import os
 import threading
@@ -159,7 +160,20 @@ class CoreWorker:
         self._actor_routes: Dict[bytes, Dict[str, Any]] = {}
         self._actor_lock = threading.Lock()
         self._actor_seqno: Dict[bytes, int] = {}
+        # Route repair (repark / re-resolve) runs on this single dispatcher
+        # thread, never on a connection's serve/writer thread: repair can
+        # block (protocol.connect retries ~30s) and takes _actor_lock, and
+        # future callbacks may fire inline on whatever thread completes the
+        # future — including one already holding _actor_lock.
+        self._route_exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rtpu-actor-route")
         self._closed = False
+
+    def _route_submit(self, fn, *args):
+        try:
+            self._route_exec.submit(fn, *args)
+        except RuntimeError:  # executor shut down: worker is disconnecting
+            pass
 
     # ----------------------------------------------------------- plumbing
 
@@ -185,6 +199,7 @@ class CoreWorker:
         if self._closed:
             return
         self._closed = True
+        self._route_exec.shutdown(wait=False)
         try:
             self.gcs.close()
         except Exception:
@@ -609,14 +624,7 @@ class CoreWorker:
             fut = conn.request_nowait("submit_actor_task", spec)
         except (protocol.ConnectionClosed, ConnectionError, OSError):
             return False
-
-        def on_ack(f):
-            try:
-                f.result(0)
-            except BaseException:
-                self._repark_actor_task(spec)
-
-        fut.add_done_callback(on_ack)
+        fut.add_done_callback(self._make_submit_ack(spec))
         return True
 
     def _make_submit_ack(self, spec):
@@ -624,7 +632,10 @@ class CoreWorker:
             try:
                 f.result(0)
             except BaseException:
-                self._repark_actor_task(spec)
+                # Hand off to the route dispatcher: this callback may run
+                # inline under _actor_lock (future already done) or on the
+                # conn's serve thread, and _repark_actor_task takes the lock.
+                self._route_submit(self._repark_actor_task, spec)
         return on_ack
 
     def _repark_actor_task(self, spec):
@@ -646,7 +657,10 @@ class CoreWorker:
                 info = f.result(0)
             except BaseException:
                 info = {"state": "DEAD", "node_address": None}
-            self._on_actor_resolved(aid, info)
+            # _on_actor_resolved may dial the target node manager (blocking
+            # up to the connect timeout) — keep that off the GCS serve
+            # thread so unrelated GCS replies keep flowing.
+            self._route_submit(self._on_actor_resolved, aid, info)
 
         fut.add_done_callback(on_done)
 

@@ -45,6 +45,13 @@ class WorkerExecutor:
         # actor state
         self.actor_instance: Any = None
         self.actor_spec: Optional[ActorCreationSpec] = None
+        # Seqnos already accepted for execution: the driver's delivery-ack
+        # repark (worker.py _repark_actor_task) resubmits specs whose ack —
+        # not necessarily the task itself — was lost, so the same seqno can
+        # arrive twice and must not run twice (reference: seq-numbered
+        # per-actor queues, direct_actor_task_submitter.h:67).
+        self._executed_seqnos: set = set()
+        self._seqno_lock = threading.Lock()
         self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
         self._aio_sem: Optional[asyncio.Semaphore] = None
         self._thread_pool = None
@@ -300,7 +307,33 @@ class WorkerExecutor:
         self.nm.flush()
         os._exit(0)
 
+    def _claim_seqno(self, spec) -> bool:
+        """True if this spec's seqno is new (claim it); False for a
+        duplicate delivery. Duplicates still get a task_done report — the
+        NM holds a current_tasks entry per submission and would otherwise
+        keep the worker BUSY forever — but their returns are whatever the
+        first execution sealed (same object IDs), so no user code reruns.
+        """
+        seqno = getattr(spec, "seqno", None)
+        if seqno is None:
+            return True
+        # Seqnos are per-caller counters (each CoreWorker numbers its own
+        # submissions), so the dedup key must include the caller.
+        seq = (getattr(spec, "caller_id", ""), seqno)
+        with self._seqno_lock:
+            if seq in self._executed_seqnos:
+                dup = True
+            else:
+                self._executed_seqnos.add(seq)
+                dup = False
+        if dup:
+            objects = [(oid.binary(), 0) for oid in spec.return_ids()]
+            self._task_done(spec, "ok", objects)
+        return not dup
+
     def _execute_actor_task(self, spec: ActorTaskSpec):
+        if not self._claim_seqno(spec):
+            return
         self._current_task_id = spec.task_id.binary()
         self._set_ctx(spec, actor_id=spec.actor_id)
         start = time.time()
@@ -338,6 +371,8 @@ class WorkerExecutor:
             self._delayed_exit()
 
     async def _run_actor_task_async(self, spec: ActorTaskSpec):
+        if not self._claim_seqno(spec):
+            return
         async with self._aio_sem:
             start = time.time()
             try:

@@ -1,0 +1,34 @@
+"""Model zoo for the TPU-native framework.
+
+The reference delegates model code to torch (Ray Train wraps user
+``nn.Module``s — ``train/torch/train_loop_utils.py:75``) and to RLlib's
+model catalog. Here models are first-class JAX pytrees designed for
+mesh-sharded execution: every parameter carries logical axis names that
+the parallel layer (``ray_tpu.parallel.sharding``) maps onto dp / fsdp /
+tp / sp mesh axes.
+
+Families:
+- ``transformer``: GPT-2-family decoder LMs (the flagship; BASELINE.json
+  config 3 "GPT-2 125M DDP-equivalent") with ring attention for long
+  context.
+- ``mlp``: small dense nets (BASELINE.json config 2 "fashion-MNIST MLP").
+"""
+
+from ray_tpu.models.transformer import (  # noqa: F401
+    GPTConfig,
+    init_params,
+    param_logical_axes,
+    forward,
+    loss_fn,
+    TrainState,
+    make_train_state,
+    make_train_step,
+    count_params,
+)
+from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_forward  # noqa: F401
+
+__all__ = [
+    "GPTConfig", "init_params", "param_logical_axes", "forward", "loss_fn",
+    "TrainState", "make_train_state", "make_train_step", "count_params",
+    "MLPConfig", "mlp_init", "mlp_forward",
+]

@@ -1,0 +1,40 @@
+"""Small dense nets (fashion-MNIST scale; BASELINE.json config 2).
+
+The reference benchmarks a 4-worker torch MLP via Ray Train
+(``release/air_tests/air_benchmarks/workloads/torch_benchmark.py``); this
+is the JAX pytree equivalent used by the train library's smoke paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Sequence[int] = (128, 128)
+    out_dim: int = 10
+    dtype: Any = jnp.float32
+
+
+def mlp_init(rng: jax.Array, cfg: MLPConfig) -> Dict[str, Any]:
+    dims = [cfg.in_dim, *cfg.hidden, cfg.out_dim]
+    keys = jax.random.split(rng, len(dims) - 1)
+    layers = []
+    for k, (din, dout) in zip(keys, zip(dims[:-1], dims[1:])):
+        w = jax.random.normal(k, (din, dout), jnp.float32) * (2.0 / din) ** 0.5
+        layers.append({"w": w.astype(cfg.dtype),
+                       "b": jnp.zeros((dout,), cfg.dtype)})
+    return {"layers": layers}
+
+
+def mlp_forward(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    *hidden, last = params["layers"]
+    for lyr in hidden:
+        x = jax.nn.relu(x @ lyr["w"] + lyr["b"])
+    return x @ last["w"] + last["b"]

@@ -109,7 +109,10 @@ def test_xla_allreduce_ops(xla_group):
 def test_xla_allgather(xla_group):
     tensors = [np.full((3,), float(i)) for i in range(8)]
     out = xla_group.allgather(tensors)
-    assert np.asarray(out[0]).shape == (8 * 3,) or np.asarray(out[0]).shape == (8, 3) or np.asarray(out[0]).shape[0] == 24
+    # Every rank gets all shards, in rank order.
+    expected = np.repeat(np.arange(8.0), 3)
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o).reshape(-1), expected)
 
 
 def test_xla_reducescatter(xla_group):
