@@ -184,6 +184,15 @@ class NodeManager:
             # workers with the full TPU environment.
             env.pop("PALLAS_AXON_POOL_IPS", None)
         env.update(env_extra or {})
+        # Workers resolve by-reference pickles (functions defined in driver
+        # modules) by importing the same modules, so they need the driver's
+        # import roots (reference: runtime_env working_dir ships driver code
+        # to workers; same-host equivalent is sharing sys.path).
+        roots = [p for p in sys.path if p and os.path.isdir(p)]
+        prior = env.get("PYTHONPATH")
+        if prior:
+            roots.append(prior)
+        env["PYTHONPATH"] = os.pathsep.join(roots)
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_NM_ADDRESS"] = self.address
         env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address

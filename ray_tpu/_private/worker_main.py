@@ -49,8 +49,10 @@ class WorkerExecutor:
         # repark (worker.py _repark_actor_task) resubmits specs whose ack —
         # not necessarily the task itself — was lost, so the same seqno can
         # arrive twice and must not run twice (reference: seq-numbered
-        # per-actor queues, direct_actor_task_submitter.h:67).
-        self._executed_seqnos: set = set()
+        # per-actor queues, direct_actor_task_submitter.h:67). Bounded
+        # memory: per caller, a contiguous high-water mark plus the
+        # out-of-order remainder set (compacted as the gap fills).
+        self._seqno_state: dict = {}  # caller_id -> [hw:int, extras:set]
         self._seqno_lock = threading.Lock()
         self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
         self._aio_sem: Optional[asyncio.Semaphore] = None
@@ -318,14 +320,20 @@ class WorkerExecutor:
         if seqno is None:
             return True
         # Seqnos are per-caller counters (each CoreWorker numbers its own
-        # submissions), so the dedup key must include the caller.
-        seq = (getattr(spec, "caller_id", ""), seqno)
+        # submissions), so dedup state is keyed by caller.
+        caller = getattr(spec, "caller_id", "")
         with self._seqno_lock:
-            if seq in self._executed_seqnos:
+            state = self._seqno_state.setdefault(caller, [-1, set()])
+            hw, extras = state
+            if seqno <= hw or seqno in extras:
                 dup = True
             else:
-                self._executed_seqnos.add(seq)
                 dup = False
+                extras.add(seqno)
+                while hw + 1 in extras:  # compact the contiguous prefix
+                    hw += 1
+                    extras.discard(hw)
+                state[0] = hw
         if dup:
             objects = [(oid.binary(), 0) for oid in spec.return_ids()]
             self._task_done(spec, "ok", objects)

@@ -1,0 +1,520 @@
+"""Dataset: lazy, block-based distributed data (reference:
+``data/dataset.py:166``; plan ``_internal/plan.py:80``; bulk executor
+``_internal/execution/bulk_executor.py:20``).
+
+A dataset is input block refs + a chain of stages. Row/batch stages fuse
+into ONE task per block at execution (the reference's stage fusion,
+``_internal/plan.py`` _optimize); all-to-all stages (repartition, shuffle,
+sort) are barriers that reshuffle materialized blocks. Results are cached
+object refs, so re-iteration is free.
+"""
+
+from __future__ import annotations
+
+import builtins
+import glob as glob_mod
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+
+# ---------------------------------------------------------------- blocks
+# A block is a list of rows. A row is either a dict (tabular) or any
+# object (simple). Batches are dicts of numpy arrays ({"item": ...} for
+# simple rows, like the reference's strict mode).
+
+
+def _rows_to_batch(rows: List[Any], batch_format: str):
+    if batch_format == "rows":
+        return rows
+    if rows and isinstance(rows[0], dict):
+        cols = {k: [r[k] for r in rows] for k in rows[0]}
+        if batch_format == "numpy":
+            return {k: np.asarray(v) for k, v in cols.items()}
+        if batch_format == "pandas":
+            import pandas as pd
+            return pd.DataFrame(cols)
+        if batch_format == "pyarrow":
+            import pyarrow as pa
+            return pa.table(cols)
+    else:
+        if batch_format == "numpy":
+            return {"item": np.asarray(rows)}
+        if batch_format == "pandas":
+            import pandas as pd
+            return pd.DataFrame({"item": rows})
+        if batch_format == "pyarrow":
+            import pyarrow as pa
+            return pa.table({"item": rows})
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def _batch_to_rows(batch) -> List[Any]:
+    if isinstance(batch, list):
+        return batch
+    if isinstance(batch, dict):
+        arrs = {k: np.asarray(v) for k, v in batch.items()}
+        n = len(next(iter(arrs.values()))) if arrs else 0
+        if set(arrs) == {"item"}:
+            return list(arrs["item"])
+        return [{k: v[i] for k, v in arrs.items()}
+                for i in builtins.range(n)]
+    try:  # pandas / arrow
+        import pandas as pd
+        if isinstance(batch, pd.DataFrame):
+            return batch.to_dict("records")
+    except ImportError:
+        pass
+    try:
+        import pyarrow as pa
+        if isinstance(batch, pa.Table):
+            return batch.to_pylist()
+    except ImportError:
+        pass
+    raise TypeError(f"cannot convert batch of type {type(batch)}")
+
+
+# ---------------------------------------------------------------- stages
+
+
+class _Stage:
+    """One logical op. kind: row | batch | block (fusable per-block)."""
+
+    def __init__(self, kind: str, fn: Callable, **kwargs):
+        self.kind = kind
+        self.fn = fn
+        self.kwargs = kwargs
+
+    def apply(self, rows: List[Any]) -> List[Any]:
+        if self.kind == "row":
+            return [y for r in rows for y in self.fn(r)]
+        if self.kind == "batch":
+            fmt = self.kwargs.get("batch_format", "numpy")
+            size = self.kwargs.get("batch_size")
+            out: List[Any] = []
+            for chunk in _chunks(rows, size or len(rows) or 1):
+                res = self.fn(_rows_to_batch(chunk, fmt))
+                out.extend(_batch_to_rows(res))
+            return out
+        if self.kind == "block":
+            return self.fn(rows)
+        raise ValueError(self.kind)
+
+
+def _chunks(seq, n):
+    for i in builtins.range(0, len(seq), n):
+        yield seq[i:i + n]
+
+
+def _apply_stages(rows: List[Any], stages: List[_Stage]) -> List[Any]:
+    for st in stages:
+        rows = st.apply(rows)
+    return rows
+
+
+# --------------------------------------------------------------- dataset
+
+
+class Dataset:
+    def __init__(self, block_refs: List[Any],
+                 stages: Optional[List[_Stage]] = None):
+        self._input_blocks = list(block_refs)
+        self._stages: List[_Stage] = list(stages or [])
+        self._cached: Optional[List[Any]] = None  # executed block refs
+
+    # -------------------------------------------------------- construction
+
+    def _with_stage(self, stage: _Stage) -> "Dataset":
+        return Dataset(self._input_blocks, self._stages + [stage])
+
+    # ------------------------------------------------------------ executor
+
+    def _execute(self) -> List[Any]:
+        """Fuse all pending stages into one task per block (bulk executor)."""
+        if self._cached is not None:
+            return self._cached
+        if not self._stages:
+            self._cached = self._input_blocks
+            return self._cached
+        stages = self._stages
+
+        @ray_tpu.remote
+        def _run_block(rows):
+            return _apply_stages(rows, stages)
+
+        self._cached = [_run_block.remote(b) for b in self._input_blocks]
+        return self._cached
+
+    def materialize(self) -> "Dataset":
+        ds = Dataset(self._execute())
+        ds._cached = ds._input_blocks
+        return ds
+
+    def _all_rows(self) -> List[Any]:
+        out: List[Any] = []
+        for rows in ray_tpu.get(self._execute()):
+            out.extend(rows)
+        return out
+
+    # ---------------------------------------------------------- transforms
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._with_stage(_Stage("row", lambda r, f=fn: [f(r)]))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with_stage(_Stage("row", fn))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with_stage(
+            _Stage("row", lambda r, f=fn: [r] if f(r) else []))
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy") -> "Dataset":
+        return self._with_stage(_Stage("batch", fn, batch_size=batch_size,
+                                       batch_format=batch_format))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(row):
+            row = dict(row)
+            row[name] = fn(row)
+            return [row]
+        return self._with_stage(_Stage("row", add))
+
+    def drop_columns(self, cols: Sequence[str]) -> "Dataset":
+        cols = set(cols)
+        return self.map(lambda r: {k: v for k, v in r.items()
+                                   if k not in cols})
+
+    def select_columns(self, cols: Sequence[str]) -> "Dataset":
+        cols = list(cols)
+        return self.map(lambda r: {k: r[k] for k in cols})
+
+    # ---------------------------------------------------------- all-to-all
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self._all_rows()
+        n = max(1, num_blocks)
+        per = (len(rows) + n - 1) // n if rows else 0
+        parts = [rows[i * per:(i + 1) * per] for i in builtins.range(n)] \
+            if per else [[] for _ in builtins.range(n)]
+        return Dataset([ray_tpu.put(p) for p in parts])
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        rows = self._all_rows()
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(rows))
+        shuffled = [rows[i] for i in order]
+        nb = max(1, len(self._input_blocks))
+        per = (len(shuffled) + nb - 1) // nb if shuffled else 1
+        return Dataset([ray_tpu.put(shuffled[i * per:(i + 1) * per])
+                        for i in builtins.range(nb)])
+
+    def sort(self, key: Optional[Any] = None,
+             descending: bool = False) -> "Dataset":
+        rows = self._all_rows()
+        if isinstance(key, str):
+            keyfn = lambda r: r[key]  # noqa: E731
+        else:
+            keyfn = key
+        rows.sort(key=keyfn, reverse=descending)
+        nb = max(1, len(self._input_blocks))
+        per = (len(rows) + nb - 1) // nb if rows else 1
+        return Dataset([ray_tpu.put(rows[i * per:(i + 1) * per])
+                        for i in builtins.range(nb)])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        a, b = self._all_rows(), other._all_rows()
+        if len(a) != len(b):
+            raise ValueError(f"zip length mismatch: {len(a)} vs {len(b)}")
+        def merge(x, y):
+            if isinstance(x, dict) and isinstance(y, dict):
+                out = dict(x)
+                for k, v in y.items():
+                    out[k + "_1" if k in out else k] = v
+                return out
+            return (x, y)
+        return Dataset([ray_tpu.put([merge(x, y) for x, y in
+                                     builtins.zip(a, b)])])
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._execute())
+        for o in others:
+            refs.extend(o._execute())
+        return Dataset(refs)
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Split into n datasets (for n data-parallel consumers; reference:
+        ``dataset.py`` split / streaming_split)."""
+        rows = self._all_rows()
+        if equal:
+            per = len(rows) // n
+            parts = [rows[i * per:(i + 1) * per] for i in builtins.range(n)]
+        else:
+            per = (len(rows) + n - 1) // n
+            parts = [rows[i * per:(i + 1) * per] for i in builtins.range(n)]
+        return [Dataset([ray_tpu.put(p)]) for p in parts]
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        return GroupedDataset(self, key)
+
+    # --------------------------------------------------------- consumption
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for ref in self._execute():
+            out.extend(ray_tpu.get(ref))
+            if len(out) >= limit:
+                return out[:limit]
+        return out
+
+    def take_all(self) -> List[Any]:
+        return self._all_rows()
+
+    def count(self) -> int:
+        @ray_tpu.remote
+        def _count(rows):
+            return len(rows)
+        return sum(ray_tpu.get([_count.remote(b) for b in self._execute()]))
+
+    def sum(self, on: Optional[str] = None):
+        rows = self._all_rows()
+        vals = [r[on] for r in rows] if on else rows
+        return sum(vals)
+
+    def min(self, on: Optional[str] = None):
+        rows = self._all_rows()
+        return min((r[on] for r in rows) if on else rows)
+
+    def max(self, on: Optional[str] = None):
+        rows = self._all_rows()
+        return max((r[on] for r in rows) if on else rows)
+
+    def mean(self, on: Optional[str] = None):
+        rows = self._all_rows()
+        vals = [r[on] for r in rows] if on else rows
+        return sum(vals) / len(vals)
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        rows = self.take(1)
+        if not rows:
+            return None
+        r = rows[0]
+        if isinstance(r, dict):
+            return {k: type(v).__name__ for k, v in r.items()}
+        return {"item": type(r).__name__}
+
+    def num_blocks(self) -> int:
+        return len(self._input_blocks)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ref in self._execute():
+            yield from ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        buf: List[Any] = []
+        for ref in self._execute():
+            buf.extend(ray_tpu.get(ref))
+            while len(buf) >= batch_size:
+                yield _rows_to_batch(buf[:batch_size], batch_format)
+                buf = buf[batch_size:]
+        if buf and not drop_last:
+            yield _rows_to_batch(buf, batch_format)
+
+    def show(self, limit: int = 20):
+        for r in self.take(limit):
+            print(r)
+
+    def to_pandas(self):
+        return _rows_to_batch(self._all_rows(), "pandas")
+
+    # -------------------------------------------------------------- output
+
+    def write_json(self, path: str):
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                for row in ray_tpu.get(ref):
+                    f.write(json.dumps(_jsonable(row)) + "\n")
+
+    def write_parquet(self, path: str):
+        import os
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            rows = ray_tpu.get(ref)
+            if not rows:
+                continue
+            pq.write_table(_rows_to_batch(rows, "pyarrow"),
+                           os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={len(self._input_blocks)}, "
+                f"stages={len(self._stages)})")
+
+
+def _jsonable(row):
+    if isinstance(row, dict):
+        return {k: _jsonable(v) for k, v in row.items()}
+    if isinstance(row, np.generic):
+        return row.item()
+    if isinstance(row, np.ndarray):
+        return row.tolist()
+    return row
+
+
+class GroupedDataset:
+    """Reference: ``data/grouped_data.py`` — map-side partial aggregation
+    per block, reduced on the driver."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, init, accum, finalize=None):
+        key = self._key
+
+        @ray_tpu.remote
+        def partial(rows):
+            acc: Dict[Any, Any] = {}
+            for r in rows:
+                k = r[key]
+                acc[k] = accum(acc.get(k, init()), r)
+            return acc
+
+        partials = ray_tpu.get(
+            [partial.remote(b) for b in self._ds._execute()])
+        merged: Dict[Any, Any] = {}
+        for p in partials:
+            for k, v in p.items():
+                merged[k] = _merge_acc(merged.get(k), v)
+        out = []
+        for k in sorted(merged, key=repr):
+            v = merged[k]
+            out.append({self._key: k,
+                        **(finalize(v) if finalize else v)})
+        return Dataset([ray_tpu.put(out)])
+
+    def count(self) -> Dataset:
+        return self._agg(lambda: {"count": 0},
+                         lambda a, r: {"count": a["count"] + 1})
+
+    def sum(self, on: str) -> Dataset:
+        return self._agg(lambda: {f"sum({on})": 0},
+                         lambda a, r: {f"sum({on})": a[f"sum({on})"] + r[on]})
+
+    def mean(self, on: str) -> Dataset:
+        return self._agg(
+            lambda: {"_s": 0.0, "_n": 0},
+            lambda a, r: {"_s": a["_s"] + r[on], "_n": a["_n"] + 1},
+            finalize=lambda a: {f"mean({on})": a["_s"] / a["_n"]})
+
+
+def _merge_acc(a, b):
+    if a is None:
+        return b
+    out = {}
+    for k in b:
+        out[k] = a.get(k, 0) + b[k]
+    return out
+
+
+# ------------------------------------------------------------ construction
+
+
+def _make_blocks(rows: List[Any], parallelism: int) -> List[Any]:
+    n = max(1, min(parallelism, len(rows)) if rows else 1)
+    per = (len(rows) + n - 1) // n if rows else 1
+    return [ray_tpu.put(rows[i * per:(i + 1) * per])
+            for i in builtins.range(n)]
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    return Dataset(_make_blocks(list(items), parallelism))
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return from_items(list(builtins.range(n)), parallelism=parallelism)
+
+
+def from_numpy(arr: np.ndarray, *, parallelism: int = 8) -> Dataset:
+    return from_items([{"data": row} for row in arr],
+                      parallelism=parallelism)
+
+
+def from_pandas(df, *, parallelism: int = 8) -> Dataset:
+    return from_items(df.to_dict("records"), parallelism=parallelism)
+
+
+def from_arrow(table, *, parallelism: int = 8) -> Dataset:
+    return from_items(table.to_pylist(), parallelism=parallelism)
+
+
+def _expand_paths(paths) -> List[str]:
+    import os
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def _read_files(paths, reader: Callable, parallelism: int) -> Dataset:
+    files = _expand_paths(paths)
+
+    @ray_tpu.remote
+    def load(fp):
+        return reader(fp)
+
+    refs = [load.remote(fp) for fp in files]
+    return Dataset(refs)
+
+
+def read_text(paths, *, parallelism: int = 8) -> Dataset:
+    def rd(fp):
+        with open(fp) as f:
+            return [{"text": line.rstrip("\n")} for line in f]
+    return _read_files(paths, rd, parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
+    def rd(fp):
+        with open(fp, "rb") as f:
+            return [{"bytes": f.read(), "path": fp}]
+    return _read_files(paths, rd, parallelism)
+
+
+def read_csv(paths, *, parallelism: int = 8) -> Dataset:
+    def rd(fp):
+        import pandas as pd
+        return pd.read_csv(fp).to_dict("records")
+    return _read_files(paths, rd, parallelism)
+
+
+def read_json(paths, *, parallelism: int = 8) -> Dataset:
+    def rd(fp):
+        import json
+        with open(fp) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    return _read_files(paths, rd, parallelism)
+
+
+def read_parquet(paths, *, parallelism: int = 8) -> Dataset:
+    def rd(fp):
+        import pyarrow.parquet as pq
+        return pq.read_table(fp).to_pylist()
+    return _read_files(paths, rd, parallelism)

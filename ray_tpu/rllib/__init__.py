@@ -1,0 +1,23 @@
+"""Reinforcement learning library (reference: ``rllib/`` — ~35 algorithms
+on ``Algorithm(Trainable)`` ``algorithms/algorithm.py:146``; this slice
+ships PPO on the new Learner architecture, SURVEY.md §7 step 8).
+
+Architecture (TPU-first version of the reference's split):
+- ``RolloutWorker`` actors sample environments on CPU hosts
+  (reference: ``evaluation/rollout_worker.py:166``).
+- The ``PPOLearner`` runs jitted minibatch updates — on TPU chips the
+  learner actor pins chips and the update is one compiled program
+  (reference: ``core/learner/learner.py:89`` multi-GPU Learner).
+- ``PPO.train()`` = broadcast weights → parallel sample → learner update
+  (reference: ``algorithms/algorithm.py:1309-1381`` training_step).
+"""
+
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_batches  # noqa: F401
+from ray_tpu.rllib.policy import MLPPolicy, PolicySpec  # noqa: F401
+from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
+from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner  # noqa: F401
+
+__all__ = [
+    "SampleBatch", "concat_batches", "MLPPolicy", "PolicySpec",
+    "RolloutWorker", "PPO", "PPOConfig", "PPOLearner",
+]

@@ -1,0 +1,65 @@
+"""JAX policy networks (reference: ``rllib/core/rl_module/`` RLModule —
+the torch/tf model catalog replaced by pure-pytree JAX nets).
+
+``MLPPolicy`` is an actor-critic MLP with a categorical head for discrete
+action spaces; params are a pytree suitable for mesh sharding when the
+learner runs data-parallel across chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicySpec:
+    obs_dim: int
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+
+class MLPPolicy:
+    """Stateless functions over a params pytree (jit/vmap/grad friendly)."""
+
+    def __init__(self, spec: PolicySpec):
+        self.spec = spec
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        dims = [self.spec.obs_dim, *self.spec.hidden]
+        keys = jax.random.split(rng, len(dims) + 1)
+        trunk = []
+        for k, (din, dout) in zip(keys, zip(dims[:-1], dims[1:])):
+            w = jax.random.normal(k, (din, dout)) * np.sqrt(2.0 / din)
+            trunk.append({"w": w, "b": jnp.zeros((dout,))})
+        d = dims[-1]
+        pi_w = jax.random.normal(keys[-2], (d, self.spec.num_actions)) * 0.01
+        v_w = jax.random.normal(keys[-1], (d, 1)) * 1.0
+        return {
+            "trunk": trunk,
+            "pi": {"w": pi_w, "b": jnp.zeros((self.spec.num_actions,))},
+            "v": {"w": v_w, "b": jnp.zeros((1,))},
+        }
+
+    @staticmethod
+    def forward(params, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """-> (logits [B, A], values [B])."""
+        x = obs
+        for lyr in params["trunk"]:
+            x = jnp.tanh(x @ lyr["w"] + lyr["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        values = (x @ params["v"]["w"] + params["v"]["b"])[:, 0]
+        return logits, values
+
+    @staticmethod
+    def sample_action(params, obs: jax.Array, rng: jax.Array):
+        """-> (action, logp, value) for one observation batch."""
+        logits, values = MLPPolicy.forward(params, obs)
+        action = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), action]
+        return action, logp, values

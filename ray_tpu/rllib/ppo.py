@@ -1,0 +1,227 @@
+"""PPO (reference: ``rllib/algorithms/ppo/ppo.py`` + the new Learner API
+``core/learner/learner.py:89``; training_step pattern
+``algorithms/algorithm.py:1309-1381``).
+
+``PPOLearner`` is a jitted clipped-surrogate update (one compiled XLA
+program per minibatch — on TPU the whole SGD epoch stays on-chip).
+``PPO.train()`` runs the canonical sync loop: broadcast weights to
+rollout actors, gather fragments, minibatch-SGD, report metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS, SampleBatch, concat_batches,
+)
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    env_creator: Optional[Callable[[], Any]] = None
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 200
+    gamma: float = 0.99
+    lam: float = 0.95
+    lr: float = 3e-4
+    clip_param: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_sgd_epochs: int = 4
+    sgd_minibatch_size: int = 128
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    # obs/action space; inferred from a probe env if None
+    obs_dim: Optional[int] = None
+    num_actions: Optional[int] = None
+
+    def environment(self, env_creator) -> "PPOConfig":
+        self.env_creator = env_creator
+        return self
+
+    def rollouts(self, *, num_rollout_workers: int = None,
+                 rollout_fragment_length: int = None) -> "PPOConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "PPOConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown PPO option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPOLearner:
+    """Jitted PPO update (reference: ``ppo_base_learner.py`` loss;
+    Learner.update ``core/learner/learner.py``)."""
+
+    def __init__(self, spec: PolicySpec, config: PPOConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.policy = MLPPolicy(spec)
+        self.optimizer = optax.adam(config.lr)
+        self.params = self.policy.init(jax.random.key(config.seed))
+        self.opt_state = self.optimizer.init(self.params)
+        clip, vf_c, ent_c = (config.clip_param, config.vf_coeff,
+                             config.entropy_coeff)
+
+        def loss_fn(params, batch):
+            logits, values = MLPPolicy.forward(params, batch[OBS])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch[ACTIONS][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            ratio = jnp.exp(logp - batch[LOGPS])
+            adv = batch[ADVANTAGES]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            surrogate = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            pi_loss = -surrogate.mean()
+            vf_loss = jnp.mean((values - batch[RETURNS]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pi_loss + vf_c * vf_loss - ent_c * entropy
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (total, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            aux["total_loss"] = total
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def update_from_batch(self, batch: SampleBatch, *, num_epochs: int,
+                          minibatch_size: int,
+                          rng: np.random.Generator) -> Dict[str, float]:
+        metrics = {}
+        mb = min(minibatch_size, batch.count)
+        for _ in range(num_epochs):
+            shuffled = batch.shuffle(rng)
+            for sub in shuffled.minibatches(mb):
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.opt_state, dict(sub))
+        metrics = {k: float(v) for k, v in aux.items()}
+        return metrics
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+
+
+class PPO:
+    """The Algorithm (reference: ``algorithms/algorithm.py:146`` — a Tune
+    Trainable; ``as_trainable()`` below adapts it for the Tuner)."""
+
+    def __init__(self, config: PPOConfig):
+        import ray_tpu
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        if config.env_creator is None:
+            raise ValueError("PPOConfig.environment(env_creator) required")
+        self.config = config
+
+        if config.obs_dim is None or config.num_actions is None:
+            probe = config.env_creator()
+            config.obs_dim = int(np.prod(probe.observation_space.shape))
+            config.num_actions = int(probe.action_space.n)
+            close = getattr(probe, "close", None)
+            if close:
+                close()
+        self.spec = PolicySpec(config.obs_dim, config.num_actions,
+                               config.hidden)
+        self.learner = PPOLearner(self.spec, config)
+        self._np_rng = np.random.default_rng(config.seed)
+
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=1).remote(
+                config.env_creator, self.spec, gamma=config.gamma,
+                lam=config.lam,
+                rollout_fragment_length=config.rollout_fragment_length,
+                seed=config.seed + 1 + i)
+            for i in range(config.num_rollout_workers)
+        ]
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: sync sample → learn → metrics (reference:
+        ``algorithm.py:1309`` training_step)."""
+        import ray_tpu
+
+        t0 = time.perf_counter()
+        weights = self.learner.get_weights()
+        batches = ray_tpu.get(
+            [w.sample.remote(weights) for w in self.workers])
+        batch = concat_batches(batches)
+        learn_metrics = self.learner.update_from_batch(
+            batch, num_epochs=self.config.num_sgd_epochs,
+            minibatch_size=self.config.sgd_minibatch_size,
+            rng=self._np_rng)
+        returns: List[float] = []
+        for r in ray_tpu.get(
+                [w.episode_returns.remote() for w in self.workers]):
+            returns.extend(r)
+        dt = time.perf_counter() - t0
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_this_iter": batch.count,
+            "env_steps_per_sec": batch.count / dt,
+            "episode_return_mean": float(np.mean(returns))
+            if returns else None,
+            **learn_metrics,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self):
+        import ray_tpu
+
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    @classmethod
+    def as_trainable(cls, base_config: PPOConfig,
+                     stop_iters: int = 10) -> Callable:
+        """Function trainable for the Tuner (reference: Algorithm IS a
+        Trainable; here a closure reporting per-iteration metrics)."""
+
+        def trainable(tune_config: Dict[str, Any]):
+            from ray_tpu.train import session
+
+            cfg = dataclasses.replace(base_config, **tune_config)
+            algo = cls(cfg)
+            try:
+                for _ in range(stop_iters):
+                    session.report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

@@ -1,0 +1,102 @@
+"""Environment-sampling actor (reference:
+``rllib/evaluation/rollout_worker.py:166``; ``sample()`` :886 is the RL
+hot loop — CPU-bound env stepping, kept off the TPU hosts).
+
+Each worker owns one env instance; ``sample(params)`` steps
+``rollout_fragment_length`` transitions with the given policy weights and
+returns a GAE-postprocessed SampleBatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, ADVANTAGES, DONES, LOGPS, OBS, RETURNS, REWARDS, SampleBatch,
+    VALUES, compute_gae,
+)
+
+
+class RolloutWorker:
+    def __init__(self, env_creator: Callable[[], Any], spec: PolicySpec,
+                 *, gamma: float = 0.99, lam: float = 0.95,
+                 rollout_fragment_length: int = 200, seed: int = 0):
+        import jax
+
+        self.env = env_creator()
+        self.policy = MLPPolicy(spec)
+        self.gamma = gamma
+        self.lam = lam
+        self.fragment = rollout_fragment_length
+        self._rng = jax.random.key(seed)
+        self._np_rng = np.random.default_rng(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._completed_returns: list = []
+        # jit the per-step policy evaluation once
+        self._act = jax.jit(MLPPolicy.sample_action)
+
+    def sample(self, params) -> SampleBatch:
+        import jax
+
+        obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = \
+            [], [], [], [], [], []
+        for _ in range(self.fragment):
+            self._rng, key = jax.random.split(self._rng)
+            obs = np.asarray(self._obs, np.float32)[None]
+            a, logp, v = self._act(params, obs, key)
+            a = int(a[0])
+            nxt, r, term, trunc, _ = self.env.step(a)
+            done = bool(term or trunc)
+            r = raw_r = float(r)
+            if trunc and not term:
+                # Time-limit truncation is NOT termination: bootstrap the
+                # cut-off tail with V(s') so surviving to the limit isn't
+                # penalized (reference: postprocessing.py treats truncated
+                # episodes with a final value bootstrap).
+                _, v_next = MLPPolicy.forward(
+                    params, np.asarray(nxt, np.float32)[None])
+                r += self.gamma * float(v_next[0])
+            obs_buf.append(obs[0])
+            act_buf.append(a)
+            rew_buf.append(r)
+            done_buf.append(done)
+            logp_buf.append(float(logp[0]))
+            val_buf.append(float(v[0]))
+            self._episode_return += raw_r
+            if done:
+                self._completed_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        # Bootstrap value for the (possibly unfinished) tail state.
+        if done_buf[-1]:
+            last_value = 0.0
+        else:
+            _, v = MLPPolicy.forward(
+                params, np.asarray(self._obs, np.float32)[None])
+            last_value = float(v[0])
+        rewards = np.asarray(rew_buf, np.float32)
+        values = np.asarray(val_buf, np.float32)
+        dones = np.asarray(done_buf)
+        adv, rets = compute_gae(rewards, values, dones, last_value,
+                                self.gamma, self.lam)
+        return SampleBatch({
+            OBS: np.asarray(obs_buf, np.float32),
+            ACTIONS: np.asarray(act_buf, np.int32),
+            REWARDS: rewards,
+            DONES: dones,
+            LOGPS: np.asarray(logp_buf, np.float32),
+            VALUES: values,
+            ADVANTAGES: adv.astype(np.float32),
+            RETURNS: rets.astype(np.float32),
+        })
+
+    def episode_returns(self) -> list:
+        """Completed-episode returns since last call (drained)."""
+        out, self._completed_returns = self._completed_returns, []
+        return out

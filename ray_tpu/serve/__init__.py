@@ -1,0 +1,32 @@
+"""Model serving library (reference: ``python/ray/serve`` — controller
+reconciliation ``serve/controller.py:68``, replica lifecycle
+``_private/deployment_state.py:998``, HTTP ingress
+``_private/http_proxy.py:234``, handle routing ``_private/router.py:261``).
+
+TPU-first notes: replicas pin TPU chips via actor ``num_tpus`` (the
+scheduler assigns ``TPU_VISIBLE_CHIPS``), so a deployment of JAX models
+gets one compiled program per replica chip set; autoscaling reacts to
+queue depth like the reference's ``autoscaling_policy.py:54``.
+HTTP ingress rides aiohttp (no uvicorn in this environment).
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    Application,
+    Deployment,
+    deployment,
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.config import AutoscalingConfig  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+
+__all__ = [
+    "Application", "Deployment", "deployment", "delete", "get_app_handle",
+    "get_deployment_handle", "run", "shutdown", "start", "status",
+    "AutoscalingConfig", "DeploymentHandle", "DeploymentResponse",
+]

@@ -1,0 +1,242 @@
+"""Serve controller actor (reference: ``serve/controller.py:68`` — a
+detached actor running a reconciliation loop;
+``_private/deployment_state.py:1855`` DeploymentStateManager).
+
+Holds target state per deployment (replica count, config), reconciles
+actual replica actors toward it in a background thread, autoscales from
+replica queue stats, and serves the replica directory to handles/proxies
+(the reference pushes via LongPollHost ``_private/long_poll.py:185``;
+handles here poll with a short TTL cache).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+_RECONCILE_PERIOD_S = 0.2
+_STATS_TIMEOUT_S = 2.0
+# A replica is replaced only after this many consecutive missed probes
+# (~6s busy) — long user requests must not look like death.
+_MAX_PROBE_MISSES = 30
+
+
+class _DeploymentState:
+    def __init__(self, config: dict, callable_blob: bytes,
+                 init_args, init_kwargs):
+        self.config = config
+        self.blob = callable_blob
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.replicas: List[Any] = []        # actor handles
+        self.target = config["num_replicas"]
+        self.last_scale_ts = 0.0
+        self.deleting = False
+
+
+class ServeController:
+    def __init__(self, http_port: Optional[int] = None):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._miss_counts: Dict[int, int] = {}
+        self._lock = threading.RLock()
+        self._running = True
+        self._http_port = http_port
+        self._proxy = None
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        daemon=True, name="serve-reconcile")
+        self._thread.start()
+        if http_port is not None:
+            self._start_proxy(http_port)
+
+    # ----------------------------------------------------------- deploy API
+
+    def deploy(self, config: dict, callable_blob: bytes, init_args,
+               init_kwargs) -> bool:
+        with self._lock:
+            existing = self._deployments.get(config["name"])
+            self._deployments[config["name"]] = _DeploymentState(
+                config, callable_blob, init_args, init_kwargs)
+            if existing is not None:
+                # Replace: old replicas torn down by reconcile (code push).
+                self._deployments[config["name"]].replicas = []
+                self._kill_replicas(existing.replicas)
+        name = config["name"]
+        with self._lock:
+            st = self._deployments[name]
+        self._scale_to_target(name, st)
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            st = self._deployments.pop(name, None)
+        if st is not None:
+            self._kill_replicas(st.replicas)
+        return True
+
+    def get_replicas(self, name: str) -> List[Any]:
+        with self._lock:
+            st = self._deployments.get(name)
+            return list(st.replicas) if st else []
+
+    def get_deployment_info(self, name: str) -> Optional[dict]:
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return None
+            return {"config": st.config, "num_replicas": len(st.replicas)}
+
+    def list_deployments(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: {"config": st.config,
+                        "num_replicas": len(st.replicas),
+                        "target": st.target}
+                    for n, st in self._deployments.items()}
+
+    def shutdown(self) -> bool:
+        self._running = False
+        with self._lock:
+            for st in self._deployments.values():
+                self._kill_replicas(st.replicas)
+            self._deployments.clear()
+        return True
+
+    # ------------------------------------------------------------ reconcile
+
+    def _reconcile_loop(self):
+        while self._running:
+            try:
+                self._control_cycle()
+            except Exception:
+                pass
+            time.sleep(_RECONCILE_PERIOD_S)
+
+    def _control_cycle(self):
+        """One sweep: probe all replicas IN PARALLEL once, then prune /
+        autoscale / scale from that single snapshot (a dead replica must
+        not stall the loop — probes are bounded by one wait, not one
+        blocking get per replica)."""
+        import ray_tpu
+
+        with self._lock:
+            items = list(self._deployments.items())
+        if not items:
+            return
+        probes = []  # (st, replica, ref)
+        for _, st in items:
+            for r in list(st.replicas):
+                try:
+                    probes.append((st, r, r.stats.remote()))
+                except Exception:
+                    probes.append((st, r, None))
+        refs = [ref for *_, ref in probes if ref is not None]
+        ready_set = set()
+        if refs:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=_STATS_TIMEOUT_S)
+            ready_set = {id(r) for r in ready}
+
+        stats_by_replica: Dict[int, dict] = {}
+        for st, r, ref in probes:
+            key = id(r)
+            if ref is not None and id(ref) in ready_set:
+                try:
+                    stats_by_replica[key] = ray_tpu.get(ref, timeout=1)
+                    self._miss_counts.pop(key, None)
+                    continue
+                except Exception:
+                    pass
+            # Missed probe: a busy replica (long user request) also misses —
+            # only replace after sustained misses, and KILL the old actor so
+            # a merely-slow replica can't leak and double capacity.
+            self._miss_counts[key] = self._miss_counts.get(key, 0) + 1
+            if self._miss_counts[key] >= _MAX_PROBE_MISSES:
+                self._miss_counts.pop(key, None)
+                with self._lock:
+                    if r in st.replicas:
+                        st.replicas.remove(r)
+                self._kill_replicas([r])
+
+        now = time.time()
+        for name, st in items:
+            self._autoscale_one(st, stats_by_replica, now)
+            self._scale_to_target(name, st)
+
+    def _autoscale_one(self, st: _DeploymentState,
+                       stats_by_replica: Dict[int, dict], now: float):
+        """Queue-depth policy (reference: autoscaling_policy.py:70):
+        desired = ceil(total_ongoing / target_ongoing_requests)."""
+        import math
+
+        ac = st.config.get("autoscaling_config")
+        with self._lock:
+            replicas = list(st.replicas)
+        if not ac or not replicas:
+            return
+        stats = [stats_by_replica[id(r)] for r in replicas
+                 if id(r) in stats_by_replica]
+        if not stats:
+            return
+        ongoing = sum(s["ongoing"] for s in stats)
+        desired = math.ceil(ongoing / ac["target_ongoing_requests"]) \
+            if ongoing else ac["min_replicas"]
+        desired = min(max(desired, ac["min_replicas"]), ac["max_replicas"])
+        with self._lock:
+            if desired > st.target and \
+                    now - st.last_scale_ts >= ac["upscale_delay_s"]:
+                st.target, st.last_scale_ts = desired, now
+            elif desired < st.target and \
+                    now - st.last_scale_ts >= ac["downscale_delay_s"]:
+                st.target, st.last_scale_ts = desired, now
+
+    def _scale_to_target(self, name: str, st: _DeploymentState):
+        import ray_tpu
+        from ray_tpu.serve.replica import Replica
+
+        with self._lock:
+            deficit = st.target - len(st.replicas)
+        cls = ray_tpu.remote(Replica)
+        opts = dict(st.config.get("ray_actor_options") or {})
+        # Replicas serve concurrent requests up to max_ongoing_requests
+        # (reference: DeploymentConfig.max_concurrent_queries → replica
+        # concurrency); without this, ongoing stats would always read 0
+        # and queue-depth autoscaling could never trigger.
+        opts.setdefault("max_concurrency",
+                        st.config.get("max_ongoing_requests") or 100)
+        for _ in range(max(0, deficit)):
+            rid = f"{name}#{uuid.uuid4().hex[:6]}"
+            handle = cls.options(**opts).remote(
+                st.blob, st.init_args, st.init_kwargs, name, rid,
+                user_config=st.config.get("user_config"))
+            with self._lock:
+                st.replicas.append(handle)
+        if deficit < 0:
+            with self._lock:
+                extra, st.replicas = (st.replicas[st.target:],
+                                      st.replicas[:st.target])
+            self._kill_replicas(extra)
+
+    @staticmethod
+    def _kill_replicas(replicas):
+        import ray_tpu
+
+        for r in replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    # ----------------------------------------------------------- HTTP proxy
+
+    def _start_proxy(self, port: int):
+        import ray_tpu
+        from ray_tpu.serve.proxy import HTTPProxy
+
+        cls = ray_tpu.remote(HTTPProxy)
+        self._proxy = cls.remote(port)
+        ray_tpu.get(self._proxy.ready.remote(), timeout=30)
+
+    def proxy_port(self) -> Optional[int]:
+        return self._http_port

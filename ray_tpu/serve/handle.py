@@ -1,0 +1,112 @@
+"""Deployment handles (reference: ``serve/handle.py`` DeploymentHandle +
+``_private/router.py:261`` Router).
+
+``handle.remote(...)`` picks the least-loaded replica (power of two
+choices over cached stats, reference: router's replica set scheduling)
+and returns a ``DeploymentResponse`` whose ``.result()`` blocks.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, List, Optional
+
+_REPLICA_CACHE_TTL_S = 1.0
+
+
+class DeploymentResponse:
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._method = method_name
+        self._replicas: List[Any] = []
+        self._fetched_at = 0.0
+        self._lock = threading.Lock()
+        self._rr = random.Random()
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, method_name)
+        return h
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    # ------------------------------------------------------------- routing
+
+    def _refresh(self, force: bool = False):
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        now = time.time()
+        with self._lock:
+            if not force and self._replicas and \
+                    now - self._fetched_at < _REPLICA_CACHE_TTL_S:
+                return
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        replicas = ray_tpu.get(
+            ctrl.get_replicas.remote(self.deployment_name))
+        with self._lock:
+            self._replicas = replicas
+            self._fetched_at = now
+
+    def _pick(self):
+        import ray_tpu
+
+        self._refresh()
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            # Deployment may still be reconciling — retry briefly.
+            deadline = time.time() + 10
+            while not replicas and time.time() < deadline:
+                time.sleep(0.1)
+                self._refresh(force=True)
+                with self._lock:
+                    replicas = list(self._replicas)
+            if not replicas:
+                raise RuntimeError(
+                    f"no replicas for deployment "
+                    f"{self.deployment_name!r}")
+        if len(replicas) == 1:
+            return replicas[0]
+        # Power of two choices on ongoing-request count.
+        a, b = self._rr.sample(replicas, 2)
+        try:
+            sa, sb = ray_tpu.get([a.stats.remote(), b.stats.remote()],
+                                 timeout=2)
+            return a if sa["ongoing"] <= sb["ongoing"] else b
+        except Exception:
+            return a
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        replica = self._pick()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref)
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        replica = self._handle._pick()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref)

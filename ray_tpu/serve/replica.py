@@ -1,0 +1,73 @@
+"""Replica actor (reference: ``serve/_private/replica.py:267``
+``RayServeReplica``; ``handle_request`` :514).
+
+Wraps the user's class or function. Tracks in-flight request count for
+queue-depth autoscaling and handle-side least-loaded routing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+class Replica:
+    def __init__(self, callable_blob: bytes, init_args: Tuple,
+                 init_kwargs: Dict, deployment_name: str, replica_id: str,
+                 user_config: Any = None):
+        import cloudpickle
+
+        target = cloudpickle.loads(callable_blob)
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if inspect.isclass(target):
+            self._instance = target(*init_args, **init_kwargs)
+            self._callable = self._instance
+        else:
+            if init_args or init_kwargs:
+                raise TypeError(
+                    "function deployments take no init args")
+            self._instance = None
+            self._callable = target
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config: Any):
+        """Reference: replica.py reconfigure — dynamic user_config push."""
+        fn = getattr(self._instance, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total,
+                    "replica_id": self.replica_id}
+
+    def check_health(self) -> bool:
+        fn = getattr(self._instance, "check_health", None)
+        if fn is not None:
+            fn()
+        return True

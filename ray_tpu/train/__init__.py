@@ -1,0 +1,32 @@
+"""Distributed training library (reference: ``python/ray/train`` +
+``python/ray/air``).
+
+TPU-first differences from the reference:
+- The per-worker backend setup is a *collective group* (XLA mesh over ICI
+  on TPU, object-store rendezvous on CPU) instead of a torch process
+  group (reference: ``train/torch/config.py:69``
+  ``_setup_torch_process_group``).
+- "prepare_model" is a sharding rule table (``ray_tpu.parallel.sharding``)
+  — the model never changes, DP/FSDP/TP is declarative (reference:
+  ``train/torch/train_loop_utils.py:75`` wraps DDP/FSDP modules).
+- Checkpoints are orbax-compatible pytree directories (reference:
+  ``air/checkpoint.py:63`` dict/dir/URI Checkpoint).
+"""
+
+from ray_tpu.train.config import (  # noqa: F401
+    ScalingConfig, RunConfig, FailureConfig, CheckpointConfig, Result,
+)
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train import session  # noqa: F401
+from ray_tpu.train.session import (  # noqa: F401
+    report, get_checkpoint, get_world_rank, get_world_size, get_local_rank,
+    get_context,
+)
+from ray_tpu.train.data_parallel import DataParallelTrainer, JaxTrainer  # noqa: F401
+
+__all__ = [
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "Result", "Checkpoint", "session", "report", "get_checkpoint",
+    "get_world_rank", "get_world_size", "get_local_rank", "get_context",
+    "DataParallelTrainer", "JaxTrainer",
+]

@@ -1,0 +1,98 @@
+"""Universal checkpoint currency (reference: ``air/checkpoint.py:63`` —
+dict / directory interconvertible), with first-class JAX pytree support
+via orbax.
+
+A checkpoint is a directory. Dict checkpoints serialize to
+``<dir>/_dict.pkl``; pytree checkpoints are orbax ``PyTreeCheckpointer``
+layouts (``<dir>/pytree/``) readable by any orbax-compatible tool, which
+is the ecosystem's interchange format for sharded TPU state.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+_DICT_FILE = "_dict.pkl"
+_PYTREE_DIR = "pytree"
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # ------------------------------------------------------------- creation
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any],
+                  path: Optional[str] = None) -> "Checkpoint":
+        path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, _DICT_FILE), "wb") as f:
+            pickle.dump(data, f)
+        return cls(path)
+
+    @classmethod
+    def from_pytree(cls, tree: Any, path: Optional[str] = None,
+                    extra: Optional[Dict[str, Any]] = None) -> "Checkpoint":
+        """Save a JAX pytree (params / TrainState) with orbax; ``extra``
+        holds small picklable metadata (step, config)."""
+        path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(path, _PYTREE_DIR), tree, force=True)
+        if extra is not None:
+            with open(os.path.join(path, _DICT_FILE), "wb") as f:
+                pickle.dump(extra, f)
+        return cls(path)
+
+    # -------------------------------------------------------------- reading
+
+    def to_dict(self) -> Dict[str, Any]:
+        fp = os.path.join(self.path, _DICT_FILE)
+        if not os.path.exists(fp):
+            raise ValueError(f"checkpoint at {self.path} has no dict payload")
+        with open(fp, "rb") as f:
+            return pickle.load(f)
+
+    def to_pytree(self, target: Any = None) -> Any:
+        """Restore the orbax pytree; ``target`` (a matching pytree of
+        arrays/ShapeDtypeStructs) restores with the target's shardings."""
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        item = os.path.join(self.path, _PYTREE_DIR)
+        if target is not None:
+            return ckptr.restore(item, item=target)
+        return ckptr.restore(item)
+
+    def has_pytree(self) -> bool:
+        return os.path.isdir(os.path.join(self.path, _PYTREE_DIR))
+
+    # ------------------------------------------------------------ transport
+
+    def to_directory(self, path: str) -> str:
+        if os.path.abspath(path) != self.path:
+            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    def move_to(self, path: str) -> "Checkpoint":
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.abspath(path) != self.path:
+            if os.path.exists(path):
+                shutil.rmtree(path)
+            shutil.move(self.path, path)
+        return Checkpoint(path)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"

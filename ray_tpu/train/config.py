@@ -1,0 +1,83 @@
+"""Run/scaling/failure configuration (reference: ``python/ray/air/config.py``
+``ScalingConfig`` / ``RunConfig`` / ``FailureConfig`` / ``CheckpointConfig``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each holds.
+
+    ``use_tpu`` gives each worker one TPU chip by default (the analog of
+    the reference's ``use_gpu``; the reference has no TPU resource at all —
+    ``util/accelerators/accelerators.py:1-7``). ``topology`` requests a
+    gang-scheduled ICI sub-slice (e.g. "2x2") instead of loose chips.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        return res
+
+    def bundles(self) -> List[Dict[str, float]]:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Reference: ``air/config.py`` FailureConfig (max_failures=0 → fail
+    fast; -1 → unlimited restarts)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+    verbose: int = 0
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+
+
+@dataclasses.dataclass
+class Result:
+    """Outcome of a training run (reference: ``air/result.py``)."""
+
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Checkpoint]
+    path: Optional[str]
+    error: Optional[BaseException] = None
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None

@@ -1,0 +1,147 @@
+"""Data-parallel trainer (reference: ``train/data_parallel_trainer.py:56``
+DataParallelTrainer; driving loop ``_internal/backend_executor.py:325``).
+
+``fit()`` spawns a gang of worker actors, wires them into a collective
+group, runs the user loop, streams reports, persists checkpoints under the
+run directory, and on worker failure restarts the whole gang from the
+latest checkpoint (reference: Tune's trial-level FailureConfig restart —
+here the gang is the failure domain, matching TPU slices where one dead
+host invalidates the whole mesh; SURVEY.md §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    Result, RunConfig, ScalingConfig,
+)
+from ray_tpu.train.worker_group import WorkerGroup
+
+_POLL_PERIOD_S = 0.1
+
+
+class DataParallelTrainer:
+    _default_backend = "store"
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        backend: Optional[str] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._train_loop = train_loop_per_worker
+        self._config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self._backend = backend or self._default_backend
+        self._resume_from = resume_from_checkpoint
+
+    # ----------------------------------------------------------------- fit
+
+    def fit(self) -> Result:
+        name = self.run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+        run_dir = os.path.join(self.run_config.resolved_storage_path(), name)
+        os.makedirs(run_dir, exist_ok=True)
+
+        max_failures = self.run_config.failure_config.max_failures
+        attempts_left = float("inf") if max_failures < 0 else max_failures + 1
+        latest_ckpt = self._resume_from
+        last_error: Optional[BaseException] = None
+        history = []
+        ckpt_index = 0
+
+        while attempts_left > 0:
+            attempts_left -= 1
+            group = WorkerGroup(
+                self.scaling_config.num_workers,
+                self.scaling_config.worker_resources(),
+                placement_strategy=self.scaling_config.placement_strategy,
+                backend=self._backend,
+                group_name=f"train_{name}_{uuid.uuid4().hex[:6]}",
+                experiment_name=name)
+            try:
+                group.start(self._train_loop, self._config, latest_ckpt)
+                latest_ckpt, ckpt_index, error = self._drive(
+                    group, run_dir, history, latest_ckpt, ckpt_index)
+            except BaseException as e:
+                error = e
+            finally:
+                group.shutdown()
+            if error is None:
+                return Result(
+                    metrics=history[-1] if history else None,
+                    checkpoint=latest_ckpt, path=run_dir,
+                    metrics_history=history)
+            last_error = error
+        return Result(metrics=history[-1] if history else None,
+                      checkpoint=latest_ckpt, path=run_dir,
+                      error=last_error, metrics_history=history)
+
+    # ---------------------------------------------------------------- drive
+
+    def _drive(self, group: WorkerGroup, run_dir: str, history: list,
+               latest_ckpt: Optional[Checkpoint], ckpt_index: int):
+        """Poll until every worker finishes; persist rank-0 checkpoints."""
+        keep = self.run_config.checkpoint_config.num_to_keep
+        kept: list = []
+        while True:
+            states = group.poll()
+            # Persist checkpoints and record rank-0 metrics, in report order.
+            for rank, st in enumerate(states):
+                for rep in st["reports"]:
+                    if rank != 0:
+                        continue
+                    if rep["checkpoint_path"]:
+                        ckpt_index += 1
+                        dst = os.path.join(
+                            run_dir, f"checkpoint_{ckpt_index:06d}")
+                        latest_ckpt = Checkpoint(
+                            rep["checkpoint_path"]).move_to(dst)
+                        kept.append(dst)
+                        if keep and len(kept) > keep:
+                            old = kept.pop(0)
+                            shutil.rmtree(old, ignore_errors=True)
+                    history.append(rep["metrics"])
+            errored = [(r, st) for r, st in enumerate(states)
+                       if st["state"] == "errored"]
+            if errored:
+                rank, st = errored[0]
+                return latest_ckpt, ckpt_index, TrainWorkerError(
+                    rank, st["error"])
+            if all(st["state"] == "finished" for st in states):
+                return latest_ckpt, ckpt_index, None
+            time.sleep(_POLL_PERIOD_S)
+
+
+class TrainWorkerError(RuntimeError):
+    def __init__(self, rank: int, tb: str):
+        super().__init__(f"train worker rank {rank} failed:\n{tb}")
+        self.rank = rank
+
+
+class JaxTrainer(DataParallelTrainer):
+    """DataParallelTrainer whose workers drive JAX/XLA compute.
+
+    On a TPU pod each worker is one host driving its local chips; the
+    worker's collective group backend is "xla" (mesh over ICI). On the CPU
+    test platform the "store" backend provides cross-process collectives.
+    The reference analog is TorchTrainer (``train/torch/torch_trainer.py``)
+    with NCCL swapped for compiled XLA collectives.
+    """
+
+    _default_backend = "store"
+
+    def __init__(self, *args, **kwargs):
+        if kwargs.pop("use_xla_backend", False):
+            kwargs.setdefault("backend", "xla")
+        super().__init__(*args, **kwargs)

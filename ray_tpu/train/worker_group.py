@@ -1,0 +1,173 @@
+"""Gang of training worker actors (reference:
+``train/_internal/worker_group.py:92`` WorkerGroup +
+``train/_internal/backend_executor.py:43`` BackendExecutor).
+
+Each worker actor hosts the user ``train_loop_per_worker`` on a background
+thread (the reference's ``_TrainSession`` thread) and exposes a ``poll``
+method the trainer calls to drain reports. Workers are gang-placed in a
+placement group so a multi-chip mesh lands on one ICI domain
+(STRICT_PACK) or one worker per host (STRICT_SPREAD).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.util.placement_group import (
+    placement_group, remove_placement_group,
+)
+
+
+class TrainWorker:
+    """Actor hosting one rank of the training gang."""
+
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 group_name: str, backend: str, experiment_name: str):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.group_name = group_name
+        self.backend = backend
+        self.experiment_name = experiment_name
+        self._thread: Optional[threading.Thread] = None
+        # Rendezvous env for user code that wants raw jax.distributed.
+        os.environ["RTPU_WORLD_RANK"] = str(world_rank)
+        os.environ["RTPU_WORLD_SIZE"] = str(world_size)
+        os.environ["RTPU_LOCAL_RANK"] = str(local_rank)
+
+    def setup_collective(self):
+        """Join the gang's collective group (the analog of the reference's
+        ``_setup_torch_process_group``, train/torch/config.py:69)."""
+        from ray_tpu.parallel import collective
+
+        if self.world_size > 1 and not collective.is_group_initialized(
+                self.group_name):
+            collective.init_collective_group(
+                self.world_size, self.world_rank, backend=self.backend,
+                group_name=self.group_name)
+        return True
+
+    def start(self, fn_blob: bytes, config: Optional[dict],
+              checkpoint_path: Optional[str]) -> bool:
+        fn: Callable = cloudpickle.loads(fn_blob)
+        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        sess = session_mod._init_session(
+            world_rank=self.world_rank, world_size=self.world_size,
+            local_rank=self.local_rank, checkpoint=ckpt,
+            experiment_name=self.experiment_name,
+            collective_group_name=self.group_name if self.world_size > 1
+            else "")
+
+        def run():
+            try:
+                if config is not None:
+                    fn(config)
+                else:
+                    fn()
+            except BaseException as e:  # surfaced via poll()
+                sess.error = e
+                sess.error_tb = traceback.format_exc()
+            finally:
+                sess.finished.set()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="rtpu-train-loop")
+        self._thread.start()
+        return True
+
+    def poll(self) -> Dict[str, Any]:
+        """Drain queued reports; non-blocking."""
+        sess = session_mod._get_session()
+        reports = sess.drain()
+        out_reports = []
+        for r in reports:
+            ck: Optional[Checkpoint] = r["checkpoint"]
+            out_reports.append({
+                "metrics": r["metrics"],
+                "checkpoint_path": ck.path if ck is not None else None,
+            })
+        state = "running"
+        error = None
+        if sess.finished.is_set():
+            state = "errored" if sess.error is not None else "finished"
+            if sess.error is not None:
+                error = getattr(sess, "error_tb", str(sess.error))
+        return {"reports": out_reports, "state": state, "error": error}
+
+    def teardown(self):
+        from ray_tpu.parallel import collective
+
+        try:
+            if collective.is_group_initialized(self.group_name):
+                collective.destroy_collective_group(self.group_name)
+        except Exception:
+            pass
+        session_mod._shutdown_session()
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 *, placement_strategy: str = "PACK",
+                 backend: str = "store",
+                 group_name: str = "train_default",
+                 experiment_name: str = ""):
+        self.num_workers = num_workers
+        self.group_name = group_name
+        bundles = [dict(resources_per_worker) for _ in range(num_workers)]
+        self.pg = placement_group(bundles, strategy=placement_strategy)
+        self.pg.wait(timeout_seconds=60)
+
+        cls = ray_tpu.remote(TrainWorker)
+        num_cpus = resources_per_worker.get("CPU", 1)
+        num_tpus = resources_per_worker.get("TPU", 0)
+        self.workers = [
+            cls.options(num_cpus=num_cpus, num_tpus=num_tpus,
+                        placement_group=self.pg,
+                        placement_group_bundle_index=i).remote(
+                world_rank=i, world_size=num_workers, local_rank=i,
+                group_name=group_name, backend=backend,
+                experiment_name=experiment_name)
+            for i in range(num_workers)
+        ]
+        # Rank 0 first: the store-backend coordinator actor is created by
+        # rank 0 and joined by the rest.
+        ray_tpu.get(self.workers[0].setup_collective.remote())
+        ray_tpu.get([w.setup_collective.remote()
+                     for w in self.workers[1:]])
+
+    def start(self, train_fn: Callable, config: Optional[dict],
+              checkpoint: Optional[Checkpoint]):
+        blob = cloudpickle.dumps(train_fn)
+        path = checkpoint.path if checkpoint is not None else None
+        ray_tpu.get([w.start.remote(blob, config, path)
+                     for w in self.workers])
+
+    def poll(self) -> List[Dict[str, Any]]:
+        return ray_tpu.get([w.poll.remote() for w in self.workers])
+
+    def shutdown(self, graceful: bool = True):
+        if graceful:
+            try:
+                ray_tpu.get([w.teardown.remote() for w in self.workers],
+                            timeout=10)
+            except Exception:
+                pass
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self.pg)
+        except Exception:
+            pass

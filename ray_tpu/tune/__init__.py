@@ -1,0 +1,26 @@
+"""Hyperparameter tuning library (reference: ``python/ray/tune`` —
+``Tuner.fit`` ``tune/tuner.py:327`` → ``TrialRunner`` event loop
+``tune/execution/trial_runner.py:61``).
+
+Trials are function trainables hosted in worker actors that reuse the
+train library's session/report plumbing (the reference likewise unifies
+Train and Tune on ``air.session``). Schedulers (ASHA) can stop
+underperforming trials early; failed trials retry per FailureConfig.
+"""
+
+from ray_tpu.tune.search import (  # noqa: F401
+    grid_search, choice, uniform, loguniform, randint, sample_from,
+    BasicVariantGenerator,
+)
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    FIFOScheduler, AsyncHyperBandScheduler, ASHAScheduler,
+)
+from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid  # noqa: F401
+from ray_tpu.train.session import report  # noqa: F401  (tune.report alias)
+
+__all__ = [
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "sample_from", "BasicVariantGenerator", "FIFOScheduler",
+    "AsyncHyperBandScheduler", "ASHAScheduler", "TuneConfig", "Tuner",
+    "ResultGrid", "report",
+]

@@ -1,0 +1,68 @@
+"""Trial schedulers (reference: ``tune/schedulers/``: FIFO, ASHA
+``async_hyperband.py:17``).
+
+The scheduler sees every reported result and decides CONTINUE or STOP;
+ASHA keeps the top ``1/reduction_factor`` of trials at each rung.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+
+class AsyncHyperBandScheduler:
+    """ASHA: asynchronous successive halving. A trial reaching rung r
+    (iteration = grace_period * reduction_factor**r) continues only if its
+    metric is in the top 1/reduction_factor of completed rung-r records
+    seen so far (async — no waiting for the full cohort, reference:
+    ``async_hyperband.py`` _Bracket.on_result)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self._rungs: Dict[int, list] = defaultdict(list)
+        self._rung_levels = []
+        t = grace_period
+        while t < max_t:
+            self._rung_levels.append(t)
+            t *= reduction_factor
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for level in self._rung_levels:
+            if t == level:
+                rung = self._rungs[level]
+                rung.append(value if self.mode == "min" else -value)
+                rung.sort()
+                cutoff_idx = max(0, len(rung) // self.rf - 1) \
+                    if len(rung) >= self.rf else None
+                mine = value if self.mode == "min" else -value
+                if cutoff_idx is not None and mine > rung[cutoff_idx]:
+                    decision = STOP
+        return decision
+
+
+ASHAScheduler = AsyncHyperBandScheduler

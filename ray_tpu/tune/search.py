@@ -1,0 +1,131 @@
+"""Search-space primitives and variant generation (reference:
+``tune/search/sample.py`` domains + ``tune/search/basic_variant.py:191``
+``BasicVariantGenerator`` grid/random resolution)."""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class _Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class _Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class _LogUniform(Domain):
+    def __init__(self, low, high):
+        import math
+        self._llow, self._lhigh = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self._llow, self._lhigh))
+
+
+class _Randint(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class _Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+class _Grid:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def grid_search(values: List[Any]) -> dict:
+    """Exhaustive axis: the cross product of all grid axes is generated
+    (reference: ``tune/search/variant_generator.py``)."""
+    return {"grid_search": list(values)}
+
+
+def choice(categories) -> Domain:
+    return _Categorical(categories)
+
+
+def uniform(low: float, high: float) -> Domain:
+    return _Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> Domain:
+    return _LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> Domain:
+    return _Randint(low, high)
+
+
+def sample_from(fn: Callable) -> Domain:
+    return _Function(fn)
+
+
+class BasicVariantGenerator:
+    """Expand a param_space into concrete trial configs: grid axes cross
+    multiplied, Domain leaves sampled ``num_samples`` times."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def variants(self) -> List[Dict[str, Any]]:
+        grid_axes: List[tuple] = []   # (key_path, values)
+        self._find_grids(self.param_space, (), grid_axes)
+        combos = [()] if not grid_axes else list(
+            itertools.product(*(vals for _, vals in grid_axes)))
+        out = []
+        for _ in range(self.num_samples):
+            for combo in combos:
+                overrides = {path: v for (path, _), v
+                             in zip(grid_axes, combo)}
+                out.append(self._resolve(self.param_space, (), overrides))
+        return out
+
+    def _find_grids(self, node, path, acc):
+        if isinstance(node, dict):
+            if set(node.keys()) == {"grid_search"}:
+                acc.append((path, node["grid_search"]))
+                return
+            for k, v in node.items():
+                self._find_grids(v, path + (k,), acc)
+
+    def _resolve(self, node, path, overrides):
+        if path in overrides:
+            return overrides[path]
+        if isinstance(node, dict):
+            if set(node.keys()) == {"grid_search"}:
+                return overrides[path]
+            return {k: self._resolve(v, path + (k,), overrides)
+                    for k, v in node.items()}
+        if isinstance(node, Domain):
+            return node.sample(self.rng)
+        return node

@@ -1,0 +1,239 @@
+"""Tuner + trial event loop (reference: ``tune/tuner.py:47,327`` Tuner,
+``tune/execution/trial_runner.py:61`` TrialRunner,
+``tune/execution/ray_trial_executor.py:185`` actor placement).
+
+Each trial is a function trainable hosted in a ``TrainWorker`` actor
+(world size 1), reusing the train session/report pipe. The runner loop
+launches trials up to ``max_concurrent_trials``, drains reports, lets the
+scheduler stop laggards, retries failures, and persists per-trial
+checkpoints under the experiment dir.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import Result, RunConfig
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import BasicVariantGenerator
+
+_POLL_PERIOD_S = 0.05
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    resources_per_trial: Optional[Dict[str, float]] = None
+    seed: Optional[int] = None
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.trial_id = trial_id
+        self.config = config
+        self.state = "PENDING"   # RUNNING / TERMINATED / ERROR / STOPPED
+        self.actor = None
+        self.reports: List[Dict[str, Any]] = []
+        self.checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[str] = None
+        self.retries = 0
+        self.iteration = 0
+
+    def last_metrics(self) -> Optional[Dict[str, Any]]:
+        return self.reports[-1] if self.reports else None
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], trials: List[Trial],
+                 metric: Optional[str], mode: str):
+        self._results = results
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set in TuneConfig or here)")
+        best, best_v = None, None
+        for r in self._results:
+            if r.metrics is None or metric not in r.metrics:
+                continue
+            v = r.metrics[metric]
+            better = (best_v is None or
+                      (v < best_v if mode == "min" else v > best_v))
+            if better:
+                best, best_v = r, v
+        if best is None:
+            raise RuntimeError(f"no trial reported metric {metric!r}")
+        return best
+
+    @property
+    def dataframe(self):
+        rows = []
+        for t in self._trials:
+            row = {"trial_id": t.trial_id, "state": t.state, **t.config}
+            if t.last_metrics():
+                row.update(t.last_metrics())
+            rows.append(row)
+        return rows
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    # ----------------------------------------------------------------- fit
+
+    def fit(self) -> ResultGrid:
+        from ray_tpu.train.data_parallel import DataParallelTrainer
+        from ray_tpu.train.worker_group import TrainWorker
+
+        name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+        exp_dir = os.path.join(self.run_config.resolved_storage_path(), name)
+        os.makedirs(exp_dir, exist_ok=True)
+
+        variants = BasicVariantGenerator(
+            self.param_space, self.tune_config.num_samples,
+            seed=self.tune_config.seed).variants()
+        trials = [Trial(f"{name}_{i:05d}", cfg)
+                  for i, cfg in enumerate(variants)]
+
+        if isinstance(self._trainable, DataParallelTrainer):
+            fn_blob = cloudpickle.dumps(
+                _trainer_trial_fn(self._trainable))
+        else:
+            fn_blob = cloudpickle.dumps(self._trainable)
+
+        scheduler = self.tune_config.scheduler or FIFOScheduler()
+        res = self.tune_config.resources_per_trial or {"CPU": 1.0}
+        max_conc = self.tune_config.max_concurrent_trials or \
+            max(1, len(trials))
+        max_failures = self.run_config.failure_config.max_failures
+        worker_cls = ray_tpu.remote(TrainWorker)
+
+        def launch(trial: Trial):
+            trial.actor = worker_cls.options(
+                num_cpus=res.get("CPU", 1),
+                num_tpus=res.get("TPU", 0)).remote(
+                world_rank=0, world_size=1, local_rank=0,
+                group_name="", backend="store", experiment_name=name)
+            ckpt_path = trial.checkpoint.path if trial.checkpoint else None
+            ray_tpu.get(trial.actor.start.remote(
+                fn_blob, trial.config, ckpt_path))
+            trial.state = "RUNNING"
+
+        while True:
+            running = [t for t in trials if t.state == "RUNNING"]
+            pending = [t for t in trials if t.state == "PENDING"]
+            for t in pending[:max_conc - len(running)]:
+                launch(t)
+            running = [t for t in trials if t.state == "RUNNING"]
+            if not running and not pending:
+                break
+
+            polls = ray_tpu.get([t.actor.poll.remote() for t in running])
+            for trial, st in zip(running, polls):
+                stop = False
+                for rep in st["reports"]:
+                    trial.iteration += 1
+                    metrics = dict(rep["metrics"])
+                    metrics.setdefault("training_iteration", trial.iteration)
+                    trial.reports.append(metrics)
+                    if rep["checkpoint_path"]:
+                        dst = os.path.join(exp_dir, trial.trial_id,
+                                           f"checkpoint_{trial.iteration:06d}")
+                        trial.checkpoint = Checkpoint(
+                            rep["checkpoint_path"]).move_to(dst)
+                    if scheduler.on_result(trial.trial_id, metrics) == STOP:
+                        stop = True
+                if st["state"] == "errored":
+                    self._stop_actor(trial)
+                    if max_failures < 0 or trial.retries < max_failures:
+                        trial.retries += 1
+                        trial.state = "PENDING"  # restart (from last ckpt)
+                    else:
+                        trial.state = "ERROR"
+                        trial.error = st["error"]
+                elif st["state"] == "finished":
+                    self._stop_actor(trial)
+                    trial.state = "TERMINATED"
+                elif stop:
+                    self._stop_actor(trial)
+                    trial.state = "STOPPED"
+            time.sleep(_POLL_PERIOD_S)
+
+        results = [
+            Result(metrics=t.last_metrics(), checkpoint=t.checkpoint,
+                   path=os.path.join(exp_dir, t.trial_id),
+                   error=RuntimeError(t.error) if t.error else None,
+                   metrics_history=t.reports)
+            for t in trials
+        ]
+        return ResultGrid(results, trials, self.tune_config.metric,
+                          self.tune_config.mode)
+
+    @staticmethod
+    def _stop_actor(trial: Trial):
+        try:
+            ray_tpu.get(trial.actor.teardown.remote(), timeout=5)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(trial.actor)
+        except Exception:
+            pass
+        trial.actor = None
+
+
+def _trainer_trial_fn(trainer):
+    """Wrap a DataParallelTrainer as a function trainable: each trial runs
+    ``trainer.fit()`` with the trial config merged into train_loop_config
+    (reference: ``tune/trainable/util.py`` trainable conversion —
+    Train-on-Tune, base_trainer.py:538)."""
+    import copy
+
+    def run(config):
+        from ray_tpu.train import session as sess_mod
+
+        t = copy.copy(trainer)
+        merged = dict(t._config or {})
+        merged.update(config.get("train_loop_config", config))
+        t._config = merged
+        result = t.fit()
+        if result.error is not None:
+            raise result.error
+        for m in result.metrics_history:
+            sess_mod.report(m)
+
+    return run

@@ -1,0 +1,128 @@
+"""Data library tests: transforms, fusion, all-to-all ops, IO, groupby."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_range_and_transforms(ray_cluster):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 4
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 10 == 0).take_all()
+    assert out == [x * 2 for x in range(100) if (x * 2) % 10 == 0]
+
+
+def test_flat_map_and_fusion(ray_cluster):
+    ds = rd.range(10, parallelism=2).flat_map(lambda x: [x, x])
+    assert ds.count() == 20
+    # chained stages fuse into one task per block: still 2 input blocks
+    ds2 = ds.map(lambda x: x + 1).filter(lambda x: x % 2 == 0)
+    assert len(ds2._execute()) == 2
+
+
+def test_map_batches_numpy(ray_cluster):
+    ds = rd.from_items([{"x": i, "y": i * 2} for i in range(32)],
+                       parallelism=4)
+
+    def double(batch):
+        return {"x": batch["x"] * 2, "y": batch["y"]}
+
+    out = ds.map_batches(double, batch_size=8).take_all()
+    assert out[3] == {"x": 6, "y": 6}
+
+
+def test_iter_batches_formats(ray_cluster):
+    ds = rd.from_items([{"a": i} for i in range(10)], parallelism=3)
+    batches = list(ds.iter_batches(batch_size=4, batch_format="numpy"))
+    assert [len(b["a"]) for b in batches] == [4, 4, 2]
+    np.testing.assert_array_equal(batches[0]["a"], [0, 1, 2, 3])
+    dfs = list(ds.iter_batches(batch_size=5, batch_format="pandas"))
+    assert len(dfs) == 2 and list(dfs[0]["a"]) == [0, 1, 2, 3, 4]
+
+
+def test_repartition_shuffle_sort(ray_cluster):
+    ds = rd.range(20, parallelism=2).repartition(5)
+    assert ds.num_blocks() == 5
+    assert sorted(ds.take_all()) == list(range(20))
+
+    sh = rd.range(50, parallelism=2).random_shuffle(seed=0)
+    assert sorted(sh.take_all()) == list(range(50))
+    assert sh.take_all() != list(range(50))
+
+    srt = rd.from_items([{"k": i % 7, "v": i} for i in range(21)],
+                        parallelism=3).sort("k", descending=True)
+    ks = [r["k"] for r in srt.take_all()]
+    assert ks == sorted(ks, reverse=True)
+
+
+def test_zip_union_split(ray_cluster):
+    a = rd.from_items([{"a": i} for i in range(6)])
+    b = rd.from_items([{"b": i * 10} for i in range(6)])
+    z = a.zip(b).take_all()
+    assert z[2] == {"a": 2, "b": 20}
+
+    u = rd.range(5).union(rd.range(3))
+    assert u.count() == 8
+
+    parts = rd.range(10).split(2)
+    assert [p.count() for p in parts] == [5, 5]
+
+
+def test_groupby(ray_cluster):
+    ds = rd.from_items([{"k": i % 3, "v": float(i)} for i in range(12)],
+                       parallelism=4)
+    counts = ds.groupby("k").count().take_all()
+    assert counts == [{"k": 0, "count": 4}, {"k": 1, "count": 4},
+                      {"k": 2, "count": 4}]
+    sums = ds.groupby("k").sum("v").take_all()
+    assert sums[0]["sum(v)"] == sum(float(i) for i in range(12) if i % 3 == 0)
+
+
+def test_aggregates_and_schema(ray_cluster):
+    ds = rd.from_items([{"x": i} for i in range(10)])
+    assert ds.sum("x") == 45
+    assert ds.min("x") == 0
+    assert ds.max("x") == 9
+    assert ds.mean("x") == 4.5
+    assert ds.schema() == {"x": "int"}
+
+
+def test_read_write_roundtrip(ray_cluster, tmp_path):
+    ds = rd.from_items([{"a": i, "b": f"s{i}"} for i in range(10)],
+                       parallelism=2)
+    ds.write_json(str(tmp_path / "j"))
+    back = rd.read_json(str(tmp_path / "j"))
+    assert sorted(back.take_all(), key=lambda r: r["a"]) == ds.take_all()
+
+    ds.write_parquet(str(tmp_path / "p"))
+    back2 = rd.read_parquet(str(tmp_path / "p"))
+    assert back2.count() == 10
+
+    (tmp_path / "t.txt").write_text("hello\nworld\n")
+    assert rd.read_text(str(tmp_path / "t.txt")).take_all() == [
+        {"text": "hello"}, {"text": "world"}]
+
+
+def test_from_numpy_pandas_arrow(ray_cluster):
+    arr = np.arange(12).reshape(4, 3)
+    ds = rd.from_numpy(arr)
+    np.testing.assert_array_equal(ds.take(1)[0]["data"], [0, 1, 2])
+
+    import pandas as pd
+    df = pd.DataFrame({"x": [1, 2], "y": ["a", "b"]})
+    assert rd.from_pandas(df).take_all() == [
+        {"x": 1, "y": "a"}, {"x": 2, "y": "b"}]
+
+    import pyarrow as pa
+    t = pa.table({"q": [7, 8]})
+    assert rd.from_arrow(t).count() == 2

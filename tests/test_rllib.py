@@ -1,0 +1,93 @@
+"""RLlib slice tests: GAE math, learner update, end-to-end PPO learning
+on CartPole with distributed rollout workers."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig, SampleBatch, concat_batches
+from ray_tpu.rllib.sample_batch import compute_gae
+
+
+def _cartpole():
+    import gymnasium as gym
+
+    return gym.make("CartPole-v1")
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_gae_simple():
+    rewards = np.array([1.0, 1.0, 1.0], np.float32)
+    values = np.zeros(3, np.float32)
+    dones = np.array([False, False, True])
+    adv, rets = compute_gae(rewards, values, dones, last_value=5.0,
+                            gamma=1.0, lam=1.0)
+    # terminal: no bootstrap; returns are reward-to-go
+    np.testing.assert_allclose(rets, [3.0, 2.0, 1.0])
+
+    adv2, rets2 = compute_gae(rewards, values,
+                              np.array([False, False, False]),
+                              last_value=5.0, gamma=1.0, lam=1.0)
+    np.testing.assert_allclose(rets2, [8.0, 7.0, 6.0])  # bootstrapped
+
+
+def test_batch_ops():
+    a = SampleBatch({"x": np.arange(4)})
+    b = SampleBatch({"x": np.arange(4, 6)})
+    c = concat_batches([a, b])
+    assert c.count == 6
+    mbs = list(c.minibatches(3))
+    assert len(mbs) == 2 and mbs[0].count == 3
+    sh = c.shuffle(np.random.default_rng(0))
+    assert sorted(sh["x"]) == list(range(6))
+
+
+def test_learner_reduces_loss():
+    from ray_tpu.rllib import PPOLearner
+    from ray_tpu.rllib.policy import PolicySpec
+    from ray_tpu.rllib.sample_batch import (
+        ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS,
+    )
+
+    spec = PolicySpec(obs_dim=4, num_actions=2)
+    cfg = PPOConfig()
+    learner = PPOLearner(spec, cfg)
+    rng = np.random.default_rng(0)
+    batch = SampleBatch({
+        OBS: rng.normal(size=(256, 4)).astype(np.float32),
+        ACTIONS: rng.integers(0, 2, 256).astype(np.int32),
+        LOGPS: np.full(256, -0.69, np.float32),
+        ADVANTAGES: rng.normal(size=256).astype(np.float32),
+        RETURNS: rng.normal(size=256).astype(np.float32),
+    })
+    m1 = learner.update_from_batch(batch, num_epochs=1, minibatch_size=128,
+                                   rng=rng)
+    for _ in range(5):
+        m2 = learner.update_from_batch(batch, num_epochs=1,
+                                       minibatch_size=128, rng=rng)
+    assert m2["vf_loss"] < m1["vf_loss"]
+
+
+def test_ppo_cartpole_learns(ray_cluster):
+    algo = (PPOConfig()
+            .environment(_cartpole)
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=256)
+            .training(num_sgd_epochs=4, sgd_minibatch_size=128, lr=1e-3)
+            .build())
+    first = algo.train()
+    assert first["timesteps_this_iter"] == 512
+    assert first["env_steps_per_sec"] > 0
+    returns = []
+    for _ in range(12):
+        m = algo.train()
+        if m["episode_return_mean"] is not None:
+            returns.append(m["episode_return_mean"])
+    algo.stop()
+    # CartPole returns should clearly improve over ~13 iterations
+    assert max(returns[-3:]) > returns[0] + 20, returns

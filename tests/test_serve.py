@@ -1,0 +1,151 @@
+"""Serve tests: deployments, handles, replicas, HTTP ingress, scaling."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+HTTP_PORT = 18432
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    serve.start(http_port=HTTP_PORT)
+    yield ctx
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment_handle(serve_cluster):
+    @serve.deployment
+    def echo(payload):
+        return {"got": payload}
+
+    handle = serve.run(echo.bind(), route_prefix="/echo")
+    out = handle.remote("hi").result(timeout=30)
+    assert out == {"got": "hi"}
+
+
+def test_class_deployment_methods_and_replicas(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start):
+            self.value = start
+
+        def __call__(self, payload):
+            return self.value
+
+        def incr(self, by):
+            self.value += by
+            return self.value
+
+    handle = serve.run(Counter.bind(10), route_prefix="/counter")
+    assert handle.remote(None).result(timeout=30) == 10
+    assert handle.incr.remote(5).result(timeout=30) == 15
+    info = serve.status()["Counter"]
+    assert info["num_replicas"] == 2
+
+
+def test_http_ingress(serve_cluster):
+    @serve.deployment
+    def adder(req):
+        return {"sum": req["json"]["a"] + req["json"]["b"]}
+
+    serve.run(adder.bind(), route_prefix="/add")
+    body = json.dumps({"a": 3, "b": 4}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{HTTP_PORT}/add", data=body,
+        headers={"Content-Type": "application/json"})
+    deadline = time.time() + 30
+    last = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert out == {"sum": 7}
+            break
+        except AssertionError:
+            raise
+        except Exception as e:
+            last = e
+            time.sleep(0.5)
+    else:
+        raise AssertionError(f"HTTP ingress never answered: {last}")
+
+    # 404 for unknown route
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{HTTP_PORT}/nothing", timeout=10)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_redeploy_updates_code(serve_cluster):
+    @serve.deployment(name="ver")
+    def v1(req):
+        return 1
+
+    serve.run(v1.bind(), route_prefix="/ver")
+    h = serve.get_deployment_handle("ver")
+    assert h.remote(None).result(timeout=30) == 1
+
+    @serve.deployment(name="ver")
+    def v2(req):
+        return 2
+
+    serve.run(v2.bind(), route_prefix="/ver")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        h = serve.get_deployment_handle("ver")
+        if h.remote(None).result(timeout=30) == 2:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("redeploy never took effect")
+
+
+def test_delete_deployment(serve_cluster):
+    @serve.deployment
+    def gone(req):
+        return "here"
+
+    serve.run(gone.bind(), route_prefix="/gone")
+    assert "gone" in serve.status()
+    serve.delete("gone")
+    assert "gone" not in serve.status()
+
+
+def test_replica_failure_recovery(serve_cluster):
+    @serve.deployment(name="fragile")
+    class Fragile:
+        def __call__(self, req):
+            return "alive"
+
+        def die(self, _):
+            import os
+            os._exit(1)
+
+    handle = serve.run(Fragile.bind(), route_prefix="/fragile")
+    assert handle.remote(None).result(timeout=30) == "alive"
+    try:
+        handle.die.remote(None).result(timeout=10)
+    except Exception:
+        pass
+    # controller reconciles a fresh replica
+    deadline = time.time() + 40
+    while time.time() < deadline:
+        try:
+            h = serve.get_deployment_handle("fragile")
+            if h.remote(None).result(timeout=10) == "alive":
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    else:
+        raise AssertionError("replica never recovered")

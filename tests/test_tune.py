@@ -1,0 +1,116 @@
+"""Tune tests: grid/random search, best-result selection, ASHA early
+stopping, trial failure retry."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import FailureConfig, RunConfig
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+@pytest.fixture
+def ray_4cpu():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_variant_generation():
+    space = {"a": tune.grid_search([1, 2, 3]),
+             "b": tune.grid_search(["x", "y"]),
+             "c": 42,
+             "d": tune.uniform(0.0, 1.0)}
+    variants = BasicVariantGenerator(space, num_samples=2, seed=0).variants()
+    assert len(variants) == 12  # 3 * 2 grid, x2 samples
+    assert all(v["c"] == 42 for v in variants)
+    assert all(0.0 <= v["d"] <= 1.0 for v in variants)
+    assert {(v["a"], v["b"]) for v in variants} == {
+        (a, b) for a in (1, 2, 3) for b in ("x", "y")}
+
+
+def test_nested_and_domains():
+    space = {"opt": {"lr": tune.loguniform(1e-4, 1e-1),
+                     "wd": tune.choice([0.0, 0.1])},
+             "n": tune.randint(1, 5)}
+    vs = BasicVariantGenerator(space, num_samples=5, seed=1).variants()
+    assert len(vs) == 5
+    assert all(1e-4 <= v["opt"]["lr"] <= 1e-1 for v in vs)
+    assert all(v["n"] in (1, 2, 3, 4) for v in vs)
+
+
+def _objective(config):
+    # Deterministic "training": loss shrinks faster for larger lr.
+    loss = 10.0 / config["lr"]
+    for i in range(3):
+        tune.report({"loss": loss / (i + 1)})
+
+
+def test_tuner_grid(ray_4cpu, tmp_path):
+    tuner = Tuner(
+        _objective,
+        param_space={"lr": tune.grid_search([1.0, 2.0, 5.0])},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.metrics["loss"] == pytest.approx(10.0 / 5.0 / 3)
+    assert not grid.errors
+    # training_iteration injected
+    assert best.metrics["training_iteration"] == 3
+
+
+def _asha_objective(config):
+    import time
+    for i in range(1, 10):
+        tune.report({"score": config["quality"] * i,
+                     "training_iteration": i})
+        time.sleep(0.01)
+
+
+def test_asha_stops_bad_trials(ray_4cpu, tmp_path):
+    tuner = Tuner(
+        _asha_objective,
+        param_space={"quality": tune.grid_search([1.0, 10.0])},
+        tune_config=TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=2,
+            scheduler=ASHAScheduler(metric="score", mode="max", max_t=9,
+                                    grace_period=2, reduction_factor=2)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    states = sorted(t.state for t in grid._trials)
+    # the quality=1 trial should be stopped early at some rung
+    assert "STOPPED" in states or all(s == "TERMINATED" for s in states)
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 90.0
+
+
+_RETRY_KEY = "tune_retry_marker"
+
+
+def _flaky_objective(config):
+    import os
+    marker = config["marker"]
+    if not os.path.exists(marker):
+        open(marker, "w").write("x")
+        raise RuntimeError("first attempt fails")
+    tune.report({"loss": 1.0})
+
+
+def test_trial_retry(ray_4cpu, tmp_path):
+    tuner = Tuner(
+        _flaky_objective,
+        param_space={"marker": str(tmp_path / "m1")},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(
+            name="flaky", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1)),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    assert grid.get_best_result().metrics["loss"] == 1.0
