@@ -36,6 +36,12 @@ _TPU_LADDER = [
 
 def measure(mode: str) -> dict:
     import jax
+
+    if mode == "cpu":
+        # The sitecustomize hook pins the axon TPU plugin regardless of
+        # JAX_PLATFORMS, so the CPU fallback must switch via jax.config
+        # before first device use.
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -128,18 +134,43 @@ def _try_child(mode: str, timeout_s: int):
     return None
 
 
+def probe() -> bool:
+    """Cheap TPU-health check: device enumeration + one tiny matmul."""
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    x = jnp.ones((128, 128))
+    jax.block_until_ready(x @ x)
+    return d.platform == "tpu"
+
+
 def main():
+    if "--probe" in sys.argv:
+        return 0 if probe() else 1
+
     if "--inner" in sys.argv:
         mode = sys.argv[sys.argv.index("--inner") + 1]
         print(json.dumps(measure(mode)))
         return 0
-    for mode, *_rest, timeout_s in _TPU_LADDER:
-        result = _try_child(mode, timeout_s)
-        if result is not None:
-            print(json.dumps(result))
-            return 0
-    # Last resort: CPU smoke in-process.
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    # The remote-TPU tunnel sometimes wedges hard (jax.devices() hangs);
+    # probe first so a dead tunnel costs 90s, not the whole ladder.
+    tunnel_ok = False
+    try:
+        tunnel_ok = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            capture_output=True, timeout=90).returncode == 0
+    except subprocess.TimeoutExpired:
+        tunnel_ok = False
+
+    if tunnel_ok:
+        for mode, *_rest, timeout_s in _TPU_LADDER:
+            result = _try_child(mode, timeout_s)
+            if result is not None:
+                print(json.dumps(result))
+                return 0
+    # Last resort: CPU smoke (jax.config platform switch inside measure).
     result = _try_child("cpu", 240)
     if result is None:
         result = {"metric": "gpt2_125m_train_tokens_per_sec_per_chip",

@@ -20,6 +20,7 @@ replies are parked and fulfilled by later events or the timer thread.
 from __future__ import annotations
 
 import collections
+import itertools
 import logging
 import threading
 import time
@@ -101,6 +102,7 @@ class GcsServer:
         self._nodes: Dict[str, NodeEntry] = {}
         self._clients: Dict[str, protocol.Conn] = {}
         self._client_jobs: Dict[str, JobID] = {}
+        self._jobs: Dict[str, dict] = {}  # job hex -> info (state API)
         self._next_job = 0
 
         # function / class store + generic KV (namespaced)
@@ -204,6 +206,10 @@ class GcsServer:
 
     def _on_driver_exit(self, client_id: str):
         """Kill this driver's non-detached actors (job cleanup)."""
+        job = self._client_jobs.get(client_id)
+        if job is not None and job.hex() in self._jobs:
+            self._jobs[job.hex()]["state"] = "FINISHED"
+            self._jobs[job.hex()]["end_time"] = time.time()
         for aid, entry in list(self._actors.items()):
             if (entry.spec.caller_id == client_id
                     and entry.spec.lifetime != "detached"
@@ -242,6 +248,11 @@ class GcsServer:
                 self._next_job += 1
                 job = JobID.from_int(self._next_job)
                 self._client_jobs[cid] = job
+                self._jobs[job.hex()] = {
+                    "job_id": job.hex(), "driver_client_id": cid,
+                    "state": "RUNNING", "start_time": time.time(),
+                    "end_time": None,
+                }
             else:
                 job = p.get("job_id")
             head = next((n for n in self._nodes.values() if n.is_head), None)
@@ -1003,6 +1014,97 @@ class GcsServer:
     def _h_task_events(self, conn, p, msg_id):
         with self._lock:
             self._task_events.extend(p)
+
+    # ------------------------------------------------- state API (reference:
+    # dashboard/state_aggregator.py:134 StateAPIManager fan-out; here the
+    # GCS holds all tables, so listing is a straight read)
+
+    def _h_list_tasks(self, conn, p, msg_id):
+        limit = (p or {}).get("limit", 1000)
+        with self._lock:
+            out = []
+            for tid, (spec, node_id) in self._running_tasks.items():
+                out.append({"task_id": tid.hex(),
+                            "name": getattr(spec, "name", ""),
+                            "state": "RUNNING", "node_id": node_id})
+            for spec in self._queued_tasks:
+                out.append({"task_id": spec.task_id.hex(),
+                            "name": getattr(spec, "name", ""),
+                            "state": "PENDING_NODE_ASSIGNMENT",
+                            "node_id": None})
+            for lst in self._waiting_tasks.values():
+                for spec in lst:
+                    out.append({"task_id": spec.task_id.hex(),
+                                "name": getattr(spec, "name", ""),
+                                "state": "PENDING_ARGS_AVAIL",
+                                "node_id": None})
+            listed = {t["task_id"] for t in out}
+            for ev in reversed(self._task_events):
+                if len(out) >= limit:
+                    break
+                if ev["task_id"] in listed:
+                    continue
+                listed.add(ev["task_id"])
+                out.append({"task_id": ev["task_id"], "name": ev["name"],
+                            "state": "FINISHED" if ev["status"] == "ok"
+                            else "FAILED",
+                            "node_id": ev.get("node_id"),
+                            "start": ev.get("start"), "end": ev.get("end")})
+            conn.reply(msg_id, out[:limit])
+
+    def _h_list_objects(self, conn, p, msg_id):
+        limit = (p or {}).get("limit", 1000)
+        with self._lock:
+            out = []
+            for oid, nodes in itertools.islice(
+                    self._obj_locations.items(), limit):
+                out.append({"object_id": oid.hex(),
+                            "locations": sorted(nodes),
+                            "size": self._obj_sizes.get(oid, 0),
+                            "failed": oid in self._failed_objects})
+            conn.reply(msg_id, out)
+
+    def _h_list_jobs(self, conn, p, msg_id):
+        with self._lock:
+            conn.reply(msg_id, list(self._jobs.values()))
+
+    def _h_pending_demand(self, conn, p, msg_id):
+        """Unplaceable resource demand, for the autoscaler (reference:
+        LoadMetrics fed from GCS resource reports —
+        autoscaler/_private/load_metrics.py; demand =
+        resource_demand_scheduler.py:171 input)."""
+        with self._lock:
+            demand: List[Dict[str, float]] = []
+            for spec in self._queued_tasks:
+                r = getattr(spec, "resources", None)
+                if r:
+                    demand.append(dict(r))
+            for entry in self._actors.values():
+                if entry.state == PENDING_CREATION and entry.node_id is None:
+                    r = getattr(entry.spec, "resources", None)
+                    if r:
+                        demand.append(dict(r))
+            pg_demand: List[List[Dict[str, float]]] = []
+            for e in self._pgs.values():
+                if e.state == "PENDING":
+                    pg_demand.append([dict(b.resources)
+                                      for b in e.spec.bundles])
+            conn.reply(msg_id, {"tasks": demand, "pg_bundles": pg_demand})
+
+    def _h_summarize_tasks(self, conn, p, msg_id):
+        with self._lock:
+            by_name: Dict[str, Dict[str, int]] = {}
+            for ev in self._task_events:
+                d = by_name.setdefault(ev["name"], {})
+                k = "FINISHED" if ev["status"] == "ok" else "FAILED"
+                d[k] = d.get(k, 0) + 1
+            for _, (spec, _n) in self._running_tasks.items():
+                d = by_name.setdefault(getattr(spec, "name", ""), {})
+                d["RUNNING"] = d.get("RUNNING", 0) + 1
+            for spec in self._queued_tasks:
+                d = by_name.setdefault(getattr(spec, "name", ""), {})
+                d["PENDING"] = d.get("PENDING", 0) + 1
+            conn.reply(msg_id, by_name)
 
     def _h_get_timeline(self, conn, p, msg_id):
         with self._lock:

@@ -789,7 +789,7 @@ class _LocalCluster:
     gcs_server + raylet processes, started by _private/node.py:1145)."""
 
     def __init__(self, num_cpus, num_tpus, resources, object_store_memory,
-                 system_config=None):
+                 system_config=None, port: int = 0):
         from ray_tpu._private.gcs import GcsServer
 
         if system_config:
@@ -798,7 +798,7 @@ class _LocalCluster:
         self.session_dir = os.path.join(
             "/tmp", "ray_tpu", f"session_{int(time.time()*1000)}_{os.getpid()}")
         os.makedirs(self.session_dir, exist_ok=True)
-        self.gcs = GcsServer()
+        self.gcs = GcsServer(port=port)
         from ray_tpu._private.node_manager import NodeManager
 
         if num_cpus is None:

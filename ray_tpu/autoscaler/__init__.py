@@ -1,0 +1,19 @@
+"""Cluster autoscaler (reference: ``python/ray/autoscaler`` —
+``StandardAutoscaler.update`` ``_private/autoscaler.py:168,366``,
+bin-packing ``_private/resource_demand_scheduler.py:103,171``,
+``NodeProvider`` plugin API ``node_provider.py:13``, fake provider
+``_private/fake_multi_node/node_provider.py``).
+
+TPU-first: node types carry TPU chips and slice topology labels, so the
+demand scheduler can launch whole ICI sub-slices for gang-scheduled
+bundles instead of loose chips.
+"""
+
+from ray_tpu.autoscaler.node_provider import NodeProvider  # noqa: F401
+from ray_tpu.autoscaler.fake_provider import FakeMultiNodeProvider  # noqa: F401
+from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
+    AutoscalerConfig, NodeType, StandardAutoscaler,
+)
+
+__all__ = ["NodeProvider", "FakeMultiNodeProvider", "StandardAutoscaler",
+           "AutoscalerConfig", "NodeType"]

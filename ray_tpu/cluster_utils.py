@@ -1,0 +1,98 @@
+"""Multi-daemon test cluster on one host (reference:
+``python/ray/cluster_utils.py:99`` ``Cluster.add_node`` :165 — extra
+raylet+plasma processes on one machine; most of the reference's
+"multinode" tests run this way).
+
+``Cluster`` hosts one GCS plus N in-process ``NodeManager`` instances
+(each with its own shm object store and worker subprocess pool), so
+multi-node scheduling, spillback, and failure tests run hostless.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.node_manager import NodeManager
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        self.session_dir = os.path.join(
+            "/tmp", "ray_tpu",
+            f"cluster_{int(time.time()*1000)}_{os.getpid()}")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.gcs = GcsServer()
+        self.address = self.gcs.address
+        self.nodes: List[NodeManager] = []
+        if initialize_head:
+            self.add_node(is_head=True, **(head_node_args or {}))
+
+    def add_node(self, *, num_cpus: float = 2, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: int = 128 * 1024 * 1024,
+                 is_head: bool = False,
+                 labels: Optional[Dict[str, str]] = None) -> NodeManager:
+        nm = NodeManager(
+            gcs_address=self.address,
+            session_dir=self.session_dir,
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources,
+            object_store_memory=object_store_memory,
+            is_head=is_head and not any(
+                n for n in self.nodes),  # only one head
+            node_name=f"node{len(self.nodes)}",
+            labels=labels,
+        )
+        self.nodes.append(nm)
+        return nm
+
+    def remove_node(self, nm: NodeManager, allow_graceful: bool = True):
+        """Tear a node down (the in-process analog of SIGKILLing a raylet;
+        reference: cluster_utils.Cluster.remove_node)."""
+        if nm in self.nodes:
+            self.nodes.remove(nm)
+        nm.shutdown()
+
+    def wait_for_nodes(self, timeout: float = 30) -> bool:
+        """Wait until the GCS sees every added node alive."""
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            w = worker_mod.global_worker()
+            if w is not None:
+                alive = sum(1 for n in w.nodes() if n["Alive"])
+                if alive >= len(self.nodes):
+                    return True
+            else:
+                with self.gcs._lock:
+                    alive = sum(1 for n in self.gcs._nodes.values()
+                                if n.alive)
+                if alive >= len(self.nodes):
+                    return True
+            time.sleep(0.1)
+        return False
+
+    def connect(self, **kwargs):
+        """ray_tpu.init against this cluster."""
+        import ray_tpu
+
+        return ray_tpu.init(address=self.address, **kwargs)
+
+    def shutdown(self):
+        for nm in list(self.nodes):
+            try:
+                nm.shutdown()
+            except Exception:
+                pass
+        self.nodes.clear()
+        try:
+            self.gcs.close()
+        except Exception:
+            pass

@@ -1,0 +1,14 @@
+"""Lazy task/actor DAG API (reference: ``python/ray/dag`` —
+``dag_node.py:23`` DAGNode; used by Serve deployment graphs and
+Workflows).
+
+``fn.bind(*args)`` builds a node instead of executing;  ``.execute()``
+submits the whole graph as tasks, wiring parent results as ObjectRefs so
+the scheduler sees real data dependencies (no barrier between levels).
+"""
+
+from ray_tpu.dag.dag_node import (  # noqa: F401
+    DAGNode, FunctionNode, InputNode, bind,
+)
+
+__all__ = ["DAGNode", "FunctionNode", "InputNode", "bind"]

@@ -1,0 +1,126 @@
+"""DAG nodes (reference: ``python/ray/dag/dag_node.py:23`` DAGNode,
+``function_node.py`` FunctionNode, ``input_node.py`` InputNode)."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    """Base: a lazily-bound computation with upstream dependencies."""
+
+    def __init__(self, args: Tuple, kwargs: Dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+        self._stable_uuid = uuid.uuid4().hex
+
+    # ------------------------------------------------------------ traversal
+
+    def _upstream(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def execute(self, *input_args, **input_kwargs):
+        """Submit the DAG; returns the root's result ref (or plain value
+        for InputNode-only graphs). Each node submits exactly once even
+        with diamond dependencies (memoized by node id)."""
+        cache: Dict[str, Any] = {}
+        return self._execute_impl(cache, input_args, input_kwargs)
+
+    def _resolve_args(self, cache, input_args, input_kwargs):
+        def resolve(v):
+            if isinstance(v, DAGNode):
+                return v._execute_impl(cache, input_args, input_kwargs)
+            return v
+
+        args = tuple(resolve(a) for a in self._bound_args)
+        kwargs = {k: resolve(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for the argument passed at ``execute()`` time
+    (reference: ``input_node.py``). Supports ``with InputNode() as x:``."""
+
+    def __init__(self, index: int = 0):
+        super().__init__((), {})
+        self._index = index
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        if self._index >= len(input_args):
+            raise TypeError(
+                f"DAG executed with {len(input_args)} args but InputNode "
+                f"index {self._index} was bound")
+        return input_args[self._index]
+
+
+class FunctionNode(DAGNode):
+    """A remote function invocation bound into the graph."""
+
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        if self._stable_uuid in cache:
+            return cache[self._stable_uuid]
+        args, kwargs = self._resolve_args(cache, input_args, input_kwargs)
+        ref = self._remote_fn.remote(*args, **kwargs)
+        cache[self._stable_uuid] = ref
+        return ref
+
+
+class ClassMethodNode(DAGNode):
+    """An actor method invocation bound into the graph."""
+
+    def __init__(self, actor_method, args: Tuple, kwargs: Dict):
+        super().__init__(args, kwargs)
+        self._method = actor_method
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        if self._stable_uuid in cache:
+            return cache[self._stable_uuid]
+        args, kwargs = self._resolve_args(cache, input_args, input_kwargs)
+        ref = self._method.remote(*args, **kwargs)
+        cache[self._stable_uuid] = ref
+        return ref
+
+
+def bind(remote_target, *args, **kwargs) -> DAGNode:
+    """Build a node from a RemoteFunction / actor method without executing
+    (the reference hangs ``.bind`` on those classes; exposed functionally
+    here and monkey-patched onto RemoteFunction below)."""
+    return FunctionNode(remote_target, args, kwargs)
+
+
+def _install_bind():
+    """Give RemoteFunction and ActorMethod a ``.bind``."""
+    from ray_tpu.actor import ActorMethod
+    from ray_tpu.remote_function import RemoteFunction
+
+    def fn_bind(self, *args, **kwargs):
+        return FunctionNode(self, args, kwargs)
+
+    def method_bind(self, *args, **kwargs):
+        return ClassMethodNode(self, args, kwargs)
+
+    if not hasattr(RemoteFunction, "bind"):
+        RemoteFunction.bind = fn_bind
+    if not hasattr(ActorMethod, "bind"):
+        ActorMethod.bind = method_bind
+
+
+_install_bind()

@@ -1,0 +1,18 @@
+"""State observability API (reference: ``python/ray/experimental/state``
++ ``dashboard/state_aggregator.py:134`` — ``ray list/get/summarize``)."""
+
+from ray_tpu.experimental.state.api import (  # noqa: F401
+    get_actor,
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    summarize_tasks,
+)
+
+__all__ = [
+    "list_actors", "list_tasks", "list_nodes", "list_objects",
+    "list_placement_groups", "list_jobs", "summarize_tasks", "get_actor",
+]

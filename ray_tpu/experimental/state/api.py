@@ -1,0 +1,58 @@
+"""State API functions — thin typed reads over GCS tables (reference:
+``experimental/state/api.py``; server side ``state_aggregator.py:134``
+fans out to GCS/raylets, here the GCS is the single source of truth)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+def _gcs():
+    return worker_mod.require_worker().gcs
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _gcs().request("list_tasks", {"limit": limit})
+
+
+def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
+    out = []
+    for a in _gcs().request("list_actors")[:limit]:
+        a = dict(a)
+        aid = a.get("actor_id")
+        if hasattr(aid, "hex"):
+            a["actor_id"] = aid.hex()
+        out.append(a)
+    return out
+
+
+def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _gcs().request("nodes")[:limit]
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _gcs().request("list_objects", {"limit": limit})
+
+
+def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
+    table = _gcs().request("pg_table", {})
+    if isinstance(table, dict):
+        table = list(table.values()) if table else []
+    return table[:limit]
+
+
+def list_jobs(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _gcs().request("list_jobs")[:limit]
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    return _gcs().request("summarize_tasks")
+
+
+def get_actor(actor_id_hex: str) -> Optional[Dict[str, Any]]:
+    for a in list_actors():
+        if a.get("actor_id") == actor_id_hex:
+            return a
+    return None

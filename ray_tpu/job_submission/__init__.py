@@ -1,0 +1,15 @@
+"""Job submission (reference: ``dashboard/modules/job/`` —
+``JobSubmissionClient`` ``sdk.py:40``, ``JobManager``
+``job_manager.py:490`` driving a driver subprocess per job).
+
+A detached ``JobManager`` actor spawns each job's entrypoint as a real
+subprocess with ``RAY_TPU_ADDRESS`` pointing at the cluster, captures its
+output, and tracks status — so jobs survive the submitting client
+disconnecting.
+"""
+
+from ray_tpu.job_submission.client import (  # noqa: F401
+    JobStatus, JobSubmissionClient,
+)
+
+__all__ = ["JobSubmissionClient", "JobStatus"]

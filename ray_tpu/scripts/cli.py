@@ -1,0 +1,231 @@
+"""Cluster CLI (reference: ``python/ray/scripts/scripts.py`` —
+``ray start/stop/status`` + state CLI ``experimental/state/state_cli.py``).
+
+Usage: ``python -m ray_tpu <command>``
+  start --head [--num-cpus N] [--num-tpus N] [--port P]
+  stop
+  status  [--address ADDR]
+  list    {tasks|actors|nodes|objects|jobs|placement-groups}
+  summary tasks
+  timeline [--output FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+_PID_FILE = "/tmp/ray_tpu_head.pid"
+_ADDR_FILE = "/tmp/ray_tpu_head.addr"
+
+
+def _connect(address: str | None):
+    import ray_tpu
+
+    addr = address or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr and os.path.exists(_ADDR_FILE):
+        addr = open(_ADDR_FILE).read().strip()
+    if not addr:
+        raise SystemExit("no cluster address: pass --address, set "
+                         "RAY_TPU_ADDRESS, or run `ray_tpu start --head`")
+    ray_tpu.init(address=addr)
+    return ray_tpu
+
+
+def cmd_start(args) -> int:
+    """Start a standalone head node that outlives this command
+    (reference: ``ray start --head`` spawning gcs_server+raylet;
+    services.py:1273)."""
+    if os.path.exists(_PID_FILE):
+        pid = int(open(_PID_FILE).read())
+        try:
+            os.kill(pid, 0)
+            print(f"head already running (pid {pid}, "
+                  f"address {open(_ADDR_FILE).read().strip()})")
+            return 1
+        except OSError:
+            os.unlink(_PID_FILE)
+    # A stale addr file from a crashed head must not satisfy the
+    # readiness poll below — only the child's fresh write counts.
+    try:
+        os.unlink(_ADDR_FILE)
+    except OSError:
+        pass
+
+    pid = os.fork()
+    if pid > 0:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if os.path.exists(_ADDR_FILE):
+                addr = open(_ADDR_FILE).read().strip()
+                print(f"ray_tpu head started at {addr}")
+                print(f"connect with ray_tpu.init(address='{addr}') or "
+                      f"RAY_TPU_ADDRESS={addr}")
+                return 0
+            time.sleep(0.2)
+        print("head did not come up within 30s", file=sys.stderr)
+        return 1
+
+    # child: daemonize and host the cluster. Detach stdio so the parent's
+    # pipes close when it exits (workers/daemons inherit our fds).
+    os.setsid()
+    log_fd = os.open("/tmp/ray_tpu_head.log",
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    null_fd = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(null_fd, 0)
+    os.dup2(log_fd, 1)
+    os.dup2(log_fd, 2)
+    os.close(null_fd)
+    os.close(log_fd)
+    from ray_tpu._private import worker as worker_mod
+
+    cluster = worker_mod._LocalCluster(
+        args.num_cpus, args.num_tpus, None,
+        args.object_store_memory, None, port=args.port)
+    with open(_PID_FILE, "w") as f:
+        f.write(str(os.getpid()))
+    with open(_ADDR_FILE, "w") as f:
+        f.write(cluster.address)
+    stop = {"flag": False}
+
+    def on_term(sig, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    while not stop["flag"]:
+        time.sleep(0.5)
+    cluster.shutdown()
+    for p in (_PID_FILE, _ADDR_FILE):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    os._exit(0)
+
+
+def cmd_stop(args) -> int:
+    if not os.path.exists(_PID_FILE):
+        print("no head running")
+        return 0
+    pid = int(open(_PID_FILE).read())
+    try:
+        os.kill(pid, signal.SIGTERM)
+        print(f"stopped head (pid {pid})")
+    except OSError as e:
+        print(f"head pid {pid} not running ({e})")
+    for p in (_PID_FILE, _ADDR_FILE):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    return 0
+
+
+def cmd_status(args) -> int:
+    ray_tpu = _connect(args.address)
+    nodes = ray_tpu.nodes()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print(f"nodes: {sum(1 for n in nodes if n['Alive'])} alive / "
+          f"{len(nodes)} total")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):g} / {total[k]:g} available")
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_list(args) -> int:
+    _connect(args.address)
+    from ray_tpu.experimental import state
+
+    fns = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "objects": state.list_objects,
+        "jobs": state.list_jobs,
+        "placement-groups": state.list_placement_groups,
+    }
+    rows = fns[args.resource](limit=args.limit)
+    print(json.dumps(rows, indent=2, default=repr))
+    import ray_tpu
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_summary(args) -> int:
+    _connect(args.address)
+    from ray_tpu.experimental import state
+
+    print(json.dumps(state.summarize_tasks(), indent=2))
+    import ray_tpu
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    """Chrome-trace export (reference: ``ray timeline`` — chrome://tracing
+    format from GCS task events)."""
+    ray_tpu = _connect(args.address)
+    events = ray_tpu.timeline()
+    trace = [{
+        "name": ev["name"], "cat": ev.get("kind", "task"), "ph": "X",
+        "ts": ev["start"] * 1e6, "dur": (ev["end"] - ev["start"]) * 1e6,
+        "pid": ev.get("node_id", "")[:8], "tid": ev.get("pid", 0),
+        "args": {"status": ev.get("status")},
+    } for ev in events]
+    out = args.output or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {out}")
+    ray_tpu.shutdown()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ray_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start")
+    p.add_argument("--head", action="store_true", required=True)
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list")
+    p.add_argument("resource", choices=["tasks", "actors", "nodes",
+                                        "objects", "jobs",
+                                        "placement-groups"])
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary")
+    p.add_argument("what", choices=["tasks"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("timeline")
+    p.add_argument("--output", default=None)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

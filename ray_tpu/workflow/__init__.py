@@ -1,0 +1,23 @@
+"""Durable workflows (reference: ``python/ray/workflow`` —
+``workflow_executor.py:32`` WorkflowExecutor, ``workflow_storage.py``
+checkpointed step state).
+
+A workflow is a DAG (``ray_tpu.dag``) executed with per-step durability:
+every step's output is checkpointed to storage before the next step runs,
+so ``resume`` after a crash skips completed steps. Step identity is the
+deterministic topological position (name + index), matching the
+reference's step-name keying.
+"""
+
+from ray_tpu.workflow.execution import (  # noqa: F401
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = ["init", "run", "run_async", "resume", "get_status",
+           "get_output", "list_all"]

@@ -1,0 +1,253 @@
+"""Workflow execution + storage (reference: ``workflow/workflow_executor.py``
++ ``workflow/workflow_storage.py`` — filesystem-backed step checkpoints)."""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.dag_node import DAGNode, InputNode
+
+_storage_root: Optional[str] = None
+_lock = threading.Lock()
+
+STATUS_RUNNING = "RUNNING"
+STATUS_SUCCESSFUL = "SUCCESSFUL"
+STATUS_FAILED = "FAILED"
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the workflow storage root (reference: ``workflow.init``)."""
+    global _storage_root
+    with _lock:
+        _storage_root = storage or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_workflows")
+        os.makedirs(_storage_root, exist_ok=True)
+
+
+def _root() -> str:
+    if _storage_root is None:
+        init()
+    return _storage_root  # type: ignore[return-value]
+
+
+class _Storage:
+    def __init__(self, workflow_id: str, create: bool = False):
+        self.dir = os.path.join(_root(), workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+        if create:
+            os.makedirs(self.steps_dir, exist_ok=True)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.dir)
+
+    # ------------------------------------------------------------ metadata
+
+    def write_status(self, status: str, error: Optional[str] = None):
+        meta = {"status": status, "error": error, "updated_at": time.time()}
+        tmp = os.path.join(self.dir, ".status.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(self.dir, "status.json"))
+
+    def read_status(self) -> Dict[str, Any]:
+        try:
+            with open(os.path.join(self.dir, "status.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"status": None, "error": None}
+
+    def save_dag(self, dag_blob: bytes, input_args, input_kwargs):
+        with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+            pickle.dump({"dag": dag_blob, "args": input_args,
+                         "kwargs": input_kwargs}, f)
+
+    def load_dag(self):
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    # ---------------------------------------------------------------- steps
+
+    def step_path(self, step_id: str) -> str:
+        return os.path.join(self.steps_dir, f"{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self.step_path(step_id))
+
+    def save_step(self, step_id: str, value: Any):
+        tmp = self.step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self.step_path(step_id))
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self.step_path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save_output(self, value: Any):
+        self.save_step("__output__", value)
+
+    def load_output(self) -> Any:
+        return self.load_step("__output__")
+
+
+# ------------------------------------------------------------- step naming
+
+
+def _assign_step_ids(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic ids: post-order traversal position + target name.
+    Stable across process restarts for the same DAG structure (the
+    reference keys steps by user-visible step names; generated names here
+    since ``bind`` has no name option yet)."""
+    ids: Dict[int, str] = {}
+    counter: Dict[str, int] = {}
+    seen: set = set()
+
+    def name_of(node: DAGNode) -> str:
+        fn = getattr(node, "_remote_fn", None)
+        if fn is not None:
+            f = getattr(fn, "_function", None)
+            return getattr(f, "__name__", "step")
+        return type(node).__name__.lower()
+
+    def visit(node: DAGNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for up in node._upstream():
+            visit(up)
+        base = name_of(node)
+        n = counter.get(base, 0)
+        counter[base] = n + 1
+        ids[id(node)] = f"{base}_{n}"
+
+    visit(dag)
+    return ids
+
+
+# --------------------------------------------------------------- execution
+
+
+def _execute_durable(dag: DAGNode, store: _Storage, input_args,
+                     input_kwargs) -> Any:
+    import ray_tpu
+
+    ids = _assign_step_ids(dag)
+    memo: Dict[int, Any] = {}
+
+    def run_node(node: DAGNode) -> Any:
+        if id(node) in memo:
+            return memo[id(node)]
+        if isinstance(node, InputNode):
+            value = node._execute_impl({}, input_args, input_kwargs)
+            memo[id(node)] = value
+            return value
+        step_id = ids[id(node)]
+        if store.has_step(step_id):
+            value = store.load_step(step_id)  # resume: skip completed
+        else:
+            args = [run_node(a) if isinstance(a, DAGNode) else a
+                    for a in node._bound_args]
+            kwargs = {k: run_node(v) if isinstance(v, DAGNode) else v
+                      for k, v in node._bound_kwargs.items()}
+            ref = node._remote_fn.remote(*args, **kwargs) \
+                if hasattr(node, "_remote_fn") \
+                else node._method.remote(*args, **kwargs)
+            value = ray_tpu.get(ref)
+            store.save_step(step_id, value)
+        memo[id(node)] = value
+        return value
+
+    return run_node(dag)
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        args: tuple = (), kwargs: Optional[dict] = None) -> Any:
+    """Execute durably; returns the final output (reference:
+    ``workflow.run``)."""
+    import cloudpickle
+
+    workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:12]}"
+    store = _Storage(workflow_id, create=True)
+    if store.read_status()["status"] is not None:
+        # Step checkpoints are keyed by DAG position, not inputs — rerunning
+        # an existing id would silently replay stale results (the reference
+        # likewise rejects duplicate workflow ids; use resume() instead).
+        raise ValueError(
+            f"workflow {workflow_id!r} already exists "
+            f"({store.read_status()['status']}); use resume() or a new id")
+    store.write_status(STATUS_RUNNING)
+    store.save_dag(cloudpickle.dumps(dag), args, kwargs or {})
+    try:
+        out = _execute_durable(dag, store, args, kwargs or {})
+    except BaseException as e:
+        store.write_status(STATUS_FAILED, error=repr(e))
+        raise
+    store.save_output(out)
+    store.write_status(STATUS_SUCCESSFUL)
+    return out
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              args: tuple = (), kwargs: Optional[dict] = None):
+    """Run in a background thread; returns (workflow_id, thread)."""
+    workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:12]}"
+    t = threading.Thread(
+        target=lambda: run(dag, workflow_id=workflow_id, args=args,
+                           kwargs=kwargs),
+        daemon=True, name=f"workflow-{workflow_id}")
+    t.start()
+    return workflow_id, t
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a failed/interrupted workflow; completed steps are loaded
+    from storage, not re-executed (reference: ``workflow.resume``)."""
+    import cloudpickle
+
+    store = _Storage(workflow_id)
+    if not store.exists():
+        raise ValueError(f"no such workflow {workflow_id!r}")
+    saved = store.load_dag()
+    dag = cloudpickle.loads(saved["dag"])
+    store.write_status(STATUS_RUNNING)
+    try:
+        out = _execute_durable(dag, store, saved["args"], saved["kwargs"])
+    except BaseException as e:
+        store.write_status(STATUS_FAILED, error=repr(e))
+        raise
+    store.save_output(out)
+    store.write_status(STATUS_SUCCESSFUL)
+    return out
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    store = _Storage(workflow_id)
+    return store.read_status()["status"] if store.exists() else None
+
+
+def get_output(workflow_id: str) -> Any:
+    store = _Storage(workflow_id)
+    status = store.read_status()["status"]
+    if status != STATUS_SUCCESSFUL:
+        raise ValueError(f"workflow {workflow_id} is {status}, not "
+                         f"{STATUS_SUCCESSFUL}")
+    return store.load_output()
+
+
+def list_all(status_filter: Optional[str] = None) -> List[tuple]:
+    root = _root()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        st = _Storage(wid).read_status()["status"]
+        if st is None:
+            continue
+        if status_filter is None or st == status_filter:
+            out.append((wid, st))
+    return out

@@ -1,0 +1,121 @@
+"""Multi-node Cluster harness + autoscaler tests (reference:
+``tests/test_autoscaler_fake_multinode.py`` and cluster_utils tests)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.autoscaler import (
+    AutoscalerConfig, FakeMultiNodeProvider, NodeType, StandardAutoscaler,
+)
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 1})
+    ctx = c.connect(ignore_reinit_error=True)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_multinode_scheduling(cluster):
+    """Tasks spill to a second node when the head is saturated."""
+    cluster.add_node(num_cpus=2, resources={"special": 1.0})
+    assert cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"special": 1.0})
+    def where():
+        import ray_tpu
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    node_id = ray_tpu.get(where.remote())
+    special_node = cluster.nodes[1]
+    assert node_id == special_node.node_id
+
+
+def test_remove_node_fails_tasks_over(cluster):
+    node = cluster.add_node(num_cpus=2, resources={"doomed": 1.0})
+    assert cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"doomed": 0.5}, max_retries=0)
+    def stuck():
+        import time
+        time.sleep(60)
+
+    ref = stuck.remote()
+    time.sleep(1.0)
+    cluster.remove_node(node)
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=20)
+
+
+def test_autoscaler_scales_up_and_down(cluster):
+    provider = FakeMultiNodeProvider(cluster)
+    config = AutoscalerConfig(
+        node_types=[NodeType("cpu_worker", {"CPU": 2.0}, max_workers=3)],
+        max_workers=3, idle_timeout_s=1.5)
+    core = worker_mod.require_worker()
+    scaler = StandardAutoscaler(core.gcs, provider, config)
+
+    # Saturate: head has 1 CPU; ask for 4 CPUs worth of long tasks.
+    @ray_tpu.remote(num_cpus=1)
+    def hold(t):
+        import time
+        time.sleep(t)
+        return 1
+
+    refs = [hold.remote(6) for _ in range(4)]
+    time.sleep(0.5)
+    summary = scaler.run_once()
+    assert summary["launched"] >= 2, summary
+    assert cluster.wait_for_nodes()
+
+    # With new nodes, all tasks complete.
+    assert ray_tpu.get(refs, timeout=60) == [1, 1, 1, 1]
+
+    # After idle_timeout the fake nodes are terminated.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        scaler.run_once()
+        if not provider.non_terminated_nodes():
+            break
+        time.sleep(0.5)
+    assert not provider.non_terminated_nodes()
+
+
+def test_autoscaler_respects_max_workers(cluster):
+    provider = FakeMultiNodeProvider(cluster)
+    config = AutoscalerConfig(
+        node_types=[NodeType("cpu_worker", {"CPU": 1.0}, max_workers=2)],
+        max_workers=2, idle_timeout_s=60)
+    core = worker_mod.require_worker()
+    scaler = StandardAutoscaler(core.gcs, provider, config)
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold():
+        import time
+        time.sleep(5)
+
+    _refs = [hold.remote() for _ in range(10)]
+    time.sleep(0.5)
+    scaler.run_once()
+    scaler.run_once()
+    assert len(provider.non_terminated_nodes()) <= 2
+
+
+def test_min_workers_launched(cluster):
+    provider = FakeMultiNodeProvider(cluster)
+    config = AutoscalerConfig(
+        node_types=[NodeType("warm", {"CPU": 1.0}, min_workers=2,
+                             max_workers=4)],
+        max_workers=4, idle_timeout_s=60)
+    core = worker_mod.require_worker()
+    scaler = StandardAutoscaler(core.gcs, provider, config)
+    summary = scaler.run_once()
+    assert summary["launched"] == 2
+    assert len(provider.non_terminated_nodes()) == 2

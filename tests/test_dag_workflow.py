@@ -1,0 +1,119 @@
+"""DAG API and durable-workflow tests."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_dag_basic(ray_cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    with InputNode() as x:
+        dag = add.bind(mul.bind(x, 2), mul.bind(x, 3))
+    ref = dag.execute(5)
+    assert ray_tpu.get(ref) == 25  # 5*2 + 5*3
+
+
+def test_dag_diamond_single_execution(ray_cluster):
+    """A shared upstream node must submit exactly once."""
+    import tempfile
+
+    count_file = os.path.join(tempfile.mkdtemp(), "count")
+
+    @ray_tpu.remote
+    def once():
+        with open(count_file, "a") as f:
+            f.write("x")
+        return 1
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    shared = once.bind()
+    dag = add.bind(shared, shared)
+    assert ray_tpu.get(dag.execute()) == 2
+    assert os.path.getsize(count_file) == 1
+
+
+def test_actor_method_bind(ray_cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def incr(self, by):
+            self.v += by
+            return self.v
+
+    c = Counter.remote()
+    dag = c.incr.bind(5)
+    assert ray_tpu.get(dag.execute()) == 5
+
+
+def test_workflow_run_and_output(ray_cluster, tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as x:
+        dag = add.bind(double.bind(x), 10)
+    out = workflow.run(dag, workflow_id="wf1", args=(7,))
+    assert out == 24
+    assert workflow.get_status("wf1") == "SUCCESSFUL"
+    assert workflow.get_output("wf1") == 24
+    assert ("wf1", "SUCCESSFUL") in workflow.list_all()
+
+
+def test_workflow_resume_skips_completed_steps(ray_cluster, tmp_path):
+    workflow.init(str(tmp_path))
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir)
+
+    @ray_tpu.remote
+    def step_a():
+        open(os.path.join(marker_dir, "a"), "a").write("x")
+        return 5
+
+    @ray_tpu.remote
+    def step_b(v):
+        # fails the first time only
+        flag = os.path.join(marker_dir, "b_failed")
+        if not os.path.exists(flag):
+            open(flag, "w").write("x")
+            raise RuntimeError("transient failure")
+        return v * 3
+
+    dag = step_b.bind(step_a.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf2")
+    assert workflow.get_status("wf2") == "FAILED"
+
+    out = workflow.resume("wf2")
+    assert out == 15
+    assert workflow.get_status("wf2") == "SUCCESSFUL"
+    # step_a executed exactly once (checkpoint reused on resume)
+    assert os.path.getsize(os.path.join(marker_dir, "a")) == 1

@@ -1,0 +1,61 @@
+"""Job submission tests: real driver subprocesses against the cluster."""
+
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def client():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield JobSubmissionClient()
+    ray_tpu.shutdown()
+
+
+def test_submit_and_succeed(client, tmp_path):
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, '/root/repo')\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS'])\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        "print('result:', ray_tpu.get(f.remote(21)))\n"
+        "ray_tpu.shutdown()\n")
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} {script}",
+        runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu",
+                                  "PALLAS_AXON_POOL_IPS": ""}})
+    status = client.wait_until_finish(sid, timeout=120)
+    logs = client.get_job_logs(sid)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "result: 42" in logs
+    info = client.get_job_info(sid)
+    assert info["return_code"] == 0
+
+
+def test_failed_job(client):
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
+    assert client.wait_until_finish(sid, timeout=60) == JobStatus.FAILED
+    assert client.get_job_info(sid)["return_code"] == 3
+
+
+def test_stop_job(client):
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(300)'")
+    assert client.get_job_status(sid) == JobStatus.RUNNING
+    assert client.stop_job(sid)
+    status = client.wait_until_finish(sid, timeout=30)
+    assert status == JobStatus.STOPPED
+
+
+def test_list_jobs(client):
+    jobs = client.list_jobs()
+    assert len(jobs) >= 3
+    assert all("submission_id" in j for j in jobs)

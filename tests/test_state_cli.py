@@ -1,0 +1,92 @@
+"""State API and CLI tests."""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import state
+
+
+@pytest.fixture
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_state_api(ray_cluster):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Holder:
+        def get(self):
+            return 1
+
+    refs = [work.remote(i) for i in range(3)]
+    h = Holder.remote()
+    assert ray_tpu.get(h.get.remote()) == 1
+    ray_tpu.get(refs)
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["Alive"]
+
+    actors = state.list_actors()
+    assert any(a["class_name"] == "Holder" for a in actors)
+    aid = next(a["actor_id"] for a in actors
+               if a["class_name"] == "Holder")
+    assert state.get_actor(aid)["class_name"] == "Holder"
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        tasks = state.list_tasks()
+        done = [t for t in tasks if t["name"] == "work"
+                and t["state"] == "FINISHED"]
+        if len(done) == 3:
+            break
+        time.sleep(0.2)
+    assert len(done) == 3
+
+    summary = state.summarize_tasks()
+    assert summary.get("work", {}).get("FINISHED", 0) >= 3
+
+    objs = state.list_objects()
+    assert isinstance(objs, list)
+
+    jobs = state.list_jobs()
+    assert len(jobs) >= 1 and jobs[0]["state"] == "RUNNING"
+
+
+def test_cli_head_lifecycle(tmp_path):
+    """ray_tpu start --head / status / list nodes / stop, via real
+    subprocesses (reference: ray start smoke tests)."""
+    env = {"PATH": "/usr/bin:/bin:/usr/local/bin",
+           "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": "/root/repo", "HOME": "/root"}
+
+    def run(*args, timeout=60):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            capture_output=True, text=True, timeout=timeout, env=env)
+
+    # ensure no stale head
+    run("stop")
+    out = run("start", "--head", "--num-cpus", "2")
+    assert out.returncode == 0, out.stderr
+    assert "started at" in out.stdout
+    try:
+        st = run("status")
+        assert st.returncode == 0, st.stderr
+        assert "1 alive" in st.stdout
+
+        ls = run("list", "nodes")
+        rows = json.loads(ls.stdout)
+        assert len(rows) == 1
+    finally:
+        out = run("stop")
+        assert out.returncode == 0
