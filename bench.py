@@ -28,7 +28,7 @@ REFERENCE_TOKENS_PER_SEC_PER_CHIP = 25_000.0
 
 # (name, overrides, batch, seq, iters, warmup, timeout_s)
 _TPU_LADDER = [
-    ("full", {}, 8, 1024, 10, 2, 480),
+    ("full", {"flash_attention": True}, 8, 1024, 10, 2, 480),
     ("small", {"n_layers": 6}, 4, 512, 6, 2, 240),
     ("tiny", {"n_layers": 2}, 2, 256, 4, 1, 150),
 ]

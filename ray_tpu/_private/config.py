@@ -107,6 +107,9 @@ _d("object_store_memory", 2 * 1024 * 1024 * 1024,
 _d("object_store_dir", "/dev/shm",
    "Directory backing the store arena file (tmpfs for zero-copy).")
 _d("object_store_eviction", True, "Enable LRU eviction when full.")
+_d("object_spilling_threshold", 0.8,
+   "Store fill fraction above which sealed objects spill to disk "
+   "(reference: ray_config_def.h object_spilling_threshold).")
 
 # --- raylet / scheduling ----------------------------------------------------
 _d("num_workers_soft_limit", -1,

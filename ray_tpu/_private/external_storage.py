@@ -1,0 +1,60 @@
+"""Spill storage backends (reference: ``_private/external_storage.py:72``
+filesystem / :246 smart_open(S3) backends for object spilling)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class ExternalStorage:
+    def spill(self, object_id: bytes, data: bytes) -> str:
+        """Persist; returns a restore URL."""
+        raise NotImplementedError
+
+    def restore(self, url: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, url: str) -> None:
+        raise NotImplementedError
+
+
+class FileSystemStorage(ExternalStorage):
+    """Spill to a local directory (reference:
+    ``external_storage.py:72`` FileSystemStorage)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def spill(self, object_id: bytes, data: bytes) -> str:
+        path = os.path.join(self.directory, object_id.hex())
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return f"file://{path}"
+
+    def restore(self, url: str) -> bytes:
+        assert url.startswith("file://"), url
+        with open(url[len("file://"):], "rb") as f:
+            return f.read()
+
+    def delete(self, url: str) -> None:
+        try:
+            os.unlink(url[len("file://"):])
+        except OSError:
+            pass
+
+
+def create_storage(spec: Optional[dict], default_dir: str) -> ExternalStorage:
+    """Factory (reference: external_storage.setup_external_storage).
+    ``spec``: {"type": "filesystem", "params": {"directory_path": ...}};
+    S3/smart_open is environment-gated (no egress here)."""
+    if not spec or spec.get("type") in (None, "filesystem"):
+        params = (spec or {}).get("params", {})
+        return FileSystemStorage(
+            params.get("directory_path", default_dir))
+    raise ValueError(
+        f"unsupported external storage type {spec.get('type')!r} "
+        "(filesystem only in this environment)")

@@ -103,6 +103,8 @@ class GcsServer:
         self._clients: Dict[str, protocol.Conn] = {}
         self._client_jobs: Dict[str, JobID] = {}
         self._jobs: Dict[str, dict] = {}  # job hex -> info (state API)
+        self._metrics: Dict[str, dict] = {}  # client_id -> latest samples
+        self._spilled_objects: Dict[bytes, dict] = {}  # oid -> node/url
         self._next_job = 0
 
         # function / class store + generic KV (namespaced)
@@ -1058,15 +1060,45 @@ class GcsServer:
             out = []
             for oid, nodes in itertools.islice(
                     self._obj_locations.items(), limit):
+                spill = self._spilled_objects.get(oid)
                 out.append({"object_id": oid.hex(),
                             "locations": sorted(nodes),
                             "size": self._obj_sizes.get(oid, 0),
-                            "failed": oid in self._failed_objects})
+                            "failed": oid in self._failed_objects,
+                            "spilled_url": spill["url"] if spill else None})
             conn.reply(msg_id, out)
 
     def _h_list_jobs(self, conn, p, msg_id):
         with self._lock:
             conn.reply(msg_id, list(self._jobs.values()))
+
+    def _h_object_spilled(self, conn, p, msg_id):
+        """A node spilled an object to its disk; the node keeps serving it
+        (restore-on-fetch), so its location entry stays (reference:
+        spilled-URL tracking in the ownership directory)."""
+        with self._lock:
+            self._spilled_objects[p["object_id"]] = {
+                "node_id": p["node_id"], "url": p["url"]}
+            self._obj_locations[p["object_id"]].add(p["node_id"])
+
+    def _h_report_metrics(self, conn, p, msg_id):
+        """Store a process's latest metric samples (reference: per-node
+        MetricsAgent aggregation, _private/metrics_agent.py:375)."""
+        stale_cutoff = time.time() - 300
+        with self._lock:
+            self._metrics[p["client_id"]] = {
+                "samples": p["samples"], "ts": p["ts"]}
+            # Prune long-dead reporters so the table stays bounded.
+            for cid in [c for c, m in self._metrics.items()
+                        if m["ts"] < stale_cutoff]:
+                del self._metrics[cid]
+
+    def _h_get_metrics(self, conn, p, msg_id):
+        cutoff = time.time() - 120
+        with self._lock:
+            groups = [m["samples"] for m in self._metrics.values()
+                      if m["ts"] > cutoff]
+            conn.reply(msg_id, groups)
 
     def _h_pending_demand(self, conn, p, msg_id):
         """Unplaceable resource demand, for the autoscaler (reference:

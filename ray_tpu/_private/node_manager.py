@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Set
 
 from ray_tpu import exceptions
 from ray_tpu._private import protocol, serialization
+from ray_tpu._private.config import config
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.task_spec import (
     TPU,
@@ -121,12 +122,34 @@ class NodeManager:
             "labels": labels or {},
             "is_head": is_head,
         })
+        # Object spilling (reference: LocalObjectManager spill/restore,
+        # raylet/local_object_manager.h:41 + _private/external_storage.py).
+        from ray_tpu._private.external_storage import create_storage
+
+        self.external_storage = create_storage(
+            None, os.path.join(session_dir,
+                               f"spill_{self.node_id[:12]}"))
+        self._spilled: Dict[bytes, str] = {}   # object_id -> url
+        self._spill_lock = threading.Lock()
+        # Spill-before-evict: with spilling on, the store refuses
+        # pressure evictions (data loss) and creators call spill_now
+        # instead (reference: CreateRequestQueue + LocalObjectManager).
+        if float(config.object_spilling_threshold) > 0:
+            self.store.set_allow_evict(False)
+            # The NM's own creates (restores, error objects) spill inline.
+            self.store.on_full = lambda needed: bool(
+                self._spill_bytes(int(needed) * 2))
+
         # Prestart the pool (reference: worker_pool.h:245 PrestartWorkers).
         for _ in range(self._max_pool):
             self._spawn_worker()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
                                         name="rtpu-nm-reaper")
         self._reaper.start()
+        self._spiller = threading.Thread(target=self._spill_loop,
+                                         daemon=True,
+                                         name="rtpu-nm-spill")
+        self._spiller.start()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -146,6 +169,11 @@ class NodeManager:
                 w.proc.wait(timeout=5)
             except Exception:
                 pass
+        # The spiller touches the store; let it observe _shutdown before
+        # the store handle goes away (segfault otherwise).
+        spiller = getattr(self, "_spiller", None)
+        if spiller is not None:
+            spiller.join(timeout=2)
         self.server.close()
         try:
             self.gcs.close()
@@ -532,6 +560,10 @@ class NodeManager:
                 conn.reply(msg_id, True)
             elif mtype == "fetch_object":
                 self._on_fetch_object(conn, payload, msg_id)
+            elif mtype == "restore_object":
+                self._on_restore_object(conn, payload, msg_id)
+            elif mtype == "spill_now":
+                self._on_spill_now(conn, payload, msg_id)
             elif mtype == "store_stats":
                 conn.reply(msg_id, self.store.stats())
             else:
@@ -594,15 +626,131 @@ class NodeManager:
 
     def _on_fetch_object(self, conn, p, msg_id):
         """Serve a cross-node object pull (reference: object_manager Push,
-        protobuf/object_manager.proto:63; chunking elided — one framed blob)."""
-        view = self.store.get_buffer(p["object_id"], timeout_ms=p.get(
-            "timeout_ms", 5000))
+        protobuf/object_manager.proto:63; chunking elided — one framed blob).
+        Falls through to spill storage for objects this node spilled."""
+        oid = p["object_id"]
+        view = self.store.get_buffer(oid, timeout_ms=p.get(
+            "timeout_ms", 5000) if not self._spilled_url(oid) else 0)
         if view is None:
+            url = self._spilled_url(oid)
+            if url is not None:
+                try:
+                    conn.reply(msg_id, self.external_storage.restore(url))
+                except OSError:
+                    conn.reply(msg_id, None)
+                return
             conn.reply(msg_id, None)
             return
         try:
             data = bytes(view)
         finally:
             del view
-            self.store.release(p["object_id"])
+            self.store.release(oid)
         conn.reply(msg_id, data)
+
+    # ------------------------------------------------------------- spilling
+
+    def _spilled_url(self, oid: bytes):
+        with self._spill_lock:
+            return self._spilled.get(oid)
+
+    def _on_restore_object(self, conn, p, msg_id):
+        """Restore a spilled object into the local shared store (the local
+        analog of the reference's restore-spilled-object raylet RPC)."""
+        oid = p["object_id"]
+        if self.store.contains(oid):
+            conn.reply(msg_id, True)
+            return
+        url = self._spilled_url(oid)
+        if url is None:
+            conn.reply(msg_id, False)
+            return
+        try:
+            data = self.external_storage.restore(url)
+        except OSError:
+            conn.reply(msg_id, False)
+            return
+        try:
+            buf = self.store.create(oid, len(data))
+            buf[:] = data
+            self.store.seal(oid)
+        except plasma.ObjectExistsError:
+            pass
+        conn.reply(msg_id, True)
+
+    def _spill_loop(self):
+        """Spill LRU objects to disk under memory pressure (reference:
+        LocalObjectManager::SpillObjectsOfSize; threshold semantics from
+        ray_config_def.h object_spilling_threshold)."""
+        high = float(config.object_spilling_threshold)
+        if high <= 0:  # spilling disabled (store falls back to eviction)
+            return
+        low = max(0.0, high - 0.2)
+        while not self._shutdown:
+            time.sleep(0.5)
+            try:
+                st = self.store.stats()
+                cap = st["capacity_bytes"] or 1
+                if st["used_bytes"] / cap < high:
+                    continue
+                for oid in self.store.list_objects():
+                    if self._shutdown or \
+                            self.store.stats()["used_bytes"] / cap < low:
+                        break
+                    self._spill_one(oid)
+            except Exception:
+                logger.exception("spill cycle failed")
+
+    def _spill_one(self, oid: bytes) -> int:
+        """Spill one sealed object; returns bytes freed (0 if skipped)."""
+        if self._spilled_url(oid) is not None:
+            # Already on disk (a restored copy): dropping the in-memory
+            # copy frees space without re-writing the spill file.
+            view = self.store.get_buffer(oid, timeout_ms=0)
+            if view is None:
+                return 0
+            size = len(view)
+            del view
+            self.store.release(oid)
+            return size if self.store.delete(oid) else 0
+        view = self.store.get_buffer(oid, timeout_ms=0)
+        if view is None:
+            return 0
+        try:
+            data = bytes(view)
+        finally:
+            del view
+            self.store.release(oid)
+        url = self.external_storage.spill(oid, data)
+        with self._spill_lock:
+            self._spilled[oid] = url
+        # A pinned object (reader holds a view) can't be deleted — the
+        # disk copy is still valid, but no memory was freed, so report 0
+        # or backpressure retries would spin against an unchanged arena.
+        freed = len(data) if self.store.delete(oid) else 0
+        try:
+            self.gcs.notify("object_spilled", {
+                "node_id": self.node_id, "object_id": oid, "url": url})
+        except protocol.ConnectionClosed:
+            pass
+        logger.info("spilled object %s (%d bytes, freed %d)",
+                    oid.hex()[:16], len(data), freed)
+        return freed
+
+    def _spill_bytes(self, target: int) -> int:
+        freed = 0
+        try:
+            for oid in self.store.list_objects():
+                if freed >= target or self._shutdown:
+                    break
+                freed += self._spill_one(oid)
+        except OSError:
+            pass
+        return freed
+
+    def _on_spill_now(self, conn, p, msg_id):
+        """Synchronous spill on create-pressure (reference: plasma
+        CreateRequestQueue retry-after-spill). Frees at least ``needed``
+        bytes if possible; returns bytes freed."""
+        needed = int(p.get("needed", 0)) or (64 << 20)
+        conn.reply(msg_id, self._spill_bytes(needed * 2))

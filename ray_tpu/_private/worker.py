@@ -144,6 +144,10 @@ class CoreWorker:
         if store_path is None:
             raise RuntimeError("no object store available (no nodes?)")
         self.store = plasma.PlasmaClient(store_path)
+        self._nm_address_cache: Optional[str] = None
+        # Create-backpressure: on a full store, ask our node manager to
+        # spill before failing (reference: plasma CreateRequestQueue).
+        self.store.on_full = self._request_spill
 
         self.ctx = _TaskContext()
         self._root_task_id = TaskID.for_task(self.job_id or JobID.from_int(0))
@@ -179,6 +183,28 @@ class CoreWorker:
 
     def _on_gcs_msg(self, conn, mtype, payload, msg_id):
         pass  # drivers/workers currently receive only replies
+
+    def _own_nm_address(self) -> Optional[str]:
+        if self._nm_address_cache is None:
+            try:
+                for n in self.nodes():
+                    if n["NodeID"] == self.node_id:
+                        self._nm_address_cache = n["NodeManagerAddress"]
+                        break
+            except Exception:
+                return None
+        return self._nm_address_cache
+
+    def _request_spill(self, needed: int) -> bool:
+        addr = self._own_nm_address()
+        if addr is None:
+            return False
+        try:
+            freed = self.nm_conn(addr).request(
+                "spill_now", {"needed": needed}, timeout=120)
+        except (protocol.ConnectionClosed, TimeoutError, OSError):
+            return False
+        return bool(freed)
 
     def nm_conn(self, address: str) -> protocol.Conn:
         with self._nm_lock:
@@ -305,8 +331,17 @@ class CoreWorker:
             ready = [o for o in reply["ready"] if o in pending]
             if ready:
                 self._pull_objects(ready)
+                still_missing = False
                 for o in ready:
-                    pending.discard(o)
+                    # A pull can be undone before we read it (restored
+                    # object re-spilled under memory pressure) — only
+                    # count objects that actually landed; retry the rest.
+                    if self.store.contains(o):
+                        pending.discard(o)
+                    else:
+                        still_missing = True
+                if still_missing:
+                    time.sleep(0.05)
         return failures
 
     def _pull_objects(self, id_bytes_list: List[bytes]) -> None:
@@ -321,14 +356,28 @@ class CoreWorker:
             info = locs.get(oid) or {}
             for node_id, address in info.get("locations", []):
                 if node_id == self.node_id:
-                    # Listed as local but store.contains said no: either being
-                    # created right now or LRU-evicted. Try remote replicas
-                    # too rather than trusting the stale directory entry.
+                    # Listed as local but store.contains said no: spilled
+                    # (ask our node manager to restore from disk), being
+                    # created right now, or LRU-evicted. On restore failure
+                    # fall through to remote replicas.
+                    try:
+                        ok = self.nm_conn(address).request(
+                            "restore_object", {"object_id": oid},
+                            timeout=30)
+                    except (protocol.ConnectionClosed,
+                            protocol.RemoteCallError, TimeoutError,
+                            OSError):
+                        # Handler-side failures (e.g. StoreFullError during
+                        # restore) must fall through to remote replicas.
+                        ok = False
+                    if ok and self.store.contains(oid):
+                        break
                     continue
                 try:
                     data = self.nm_conn(address).request(
                         "fetch_object", {"object_id": oid}, timeout=60)
-                except (protocol.ConnectionClosed, TimeoutError):
+                except (protocol.ConnectionClosed,
+                        protocol.RemoteCallError, TimeoutError, OSError):
                     continue
                 if data is not None:
                     self._store_local(oid, data)

@@ -1,0 +1,9 @@
+"""Cluster dashboard (reference: ``dashboard/`` — head ``head.py:70``
+REST backend + modules for jobs/nodes/actors/metrics; the React frontend
+is replaced by a minimal status page, the REST surface by JSON under
+``/api/``, and metrics by a Prometheus ``/metrics`` endpoint).
+"""
+
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard  # noqa: F401
+
+__all__ = ["DashboardHead", "start_dashboard"]

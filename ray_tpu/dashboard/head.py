@@ -1,0 +1,162 @@
+"""Dashboard head actor: aiohttp REST over GCS state (reference:
+``dashboard/head.py:70`` + state/job/metrics modules under
+``dashboard/modules/``)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 8px}</style></head>
+<body><h2>ray_tpu cluster</h2>
+<div id=out>loading…</div>
+<script>
+async function refresh(){
+  const [nodes, jobs, summary] = await Promise.all([
+    fetch('/api/nodes').then(r=>r.json()),
+    fetch('/api/jobs').then(r=>r.json()),
+    fetch('/api/summary').then(r=>r.json())]);
+  let h = '<h3>nodes</h3><table><tr><th>id</th><th>alive</th>' +
+          '<th>resources</th><th>available</th></tr>';
+  for (const n of nodes) h += `<tr><td>${n.NodeID.slice(0,12)}</td>` +
+      `<td>${n.Alive}</td><td>${JSON.stringify(n.Resources)}</td>` +
+      `<td>${JSON.stringify(n.Available)}</td></tr>`;
+  h += '</table><h3>jobs</h3><table><tr><th>id</th><th>state</th></tr>';
+  for (const j of jobs) h += `<tr><td>${j.job_id}</td>` +
+      `<td>${j.state}</td></tr>`;
+  h += '</table><h3>task summary</h3><pre>' +
+       JSON.stringify(summary, null, 2) + '</pre>';
+  document.getElementById('out').innerHTML = h;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class DashboardHead:
+    """Actor hosting the REST server; talks to the GCS through its own
+    CoreWorker connection (it IS a worker process)."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve_thread,
+                                        daemon=True, name="dashboard")
+        self._thread.start()
+
+    def ready(self) -> int:
+        if not self._ready.wait(timeout=20):
+            raise RuntimeError("dashboard failed to start")
+        return self.port
+
+    def _serve_thread(self):
+        asyncio.run(self._serve())
+
+    async def _serve(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/{what}", self._api)
+        app.router.add_get("/metrics", self._metrics)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", self.port)
+        await site.start()
+        self._ready.set()
+        while True:
+            await asyncio.sleep(3600)
+
+    async def _index(self, request):
+        from aiohttp import web
+
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+    async def _api(self, request):
+        from aiohttp import web
+        from ray_tpu.experimental import state
+        import ray_tpu
+
+        what = request.match_info["what"]
+        loop = asyncio.get_running_loop()
+
+        def fetch():
+            if what == "nodes":
+                return state.list_nodes()
+            if what == "actors":
+                return state.list_actors()
+            if what == "tasks":
+                return state.list_tasks()
+            if what == "objects":
+                return state.list_objects()
+            if what == "jobs":
+                return state.list_jobs()
+            if what == "placement_groups":
+                return state.list_placement_groups()
+            if what == "summary":
+                return state.summarize_tasks()
+            if what == "cluster_status":
+                return {"total": ray_tpu.cluster_resources(),
+                        "available": ray_tpu.available_resources()}
+            return None
+
+        data = await loop.run_in_executor(None, fetch)
+        if data is None:
+            return web.json_response({"error": f"unknown api {what}"},
+                                     status=404)
+        return web.Response(text=json.dumps(data, default=repr),
+                            content_type="application/json")
+
+    async def _metrics(self, request):
+        from aiohttp import web
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.util import metrics as metrics_mod
+
+        loop = asyncio.get_running_loop()
+
+        def fetch():
+            w = worker_mod.require_worker()
+            groups = w.gcs.request("get_metrics")
+            groups.append(self._builtin_samples(w))
+            return metrics_mod.prometheus_text(groups)
+
+        text = await loop.run_in_executor(None, fetch)
+        return web.Response(text=text, content_type="text/plain")
+
+    @staticmethod
+    def _builtin_samples(w) -> list:
+        """Cluster-level gauges (reference: metric_defs.cc builtins)."""
+        nodes = w.nodes()
+        total = w.cluster_resources()
+        avail = w.available_resources()
+        out = [{"name": "ray_tpu_cluster_nodes_alive",
+                "tags": {}, "value": sum(1 for n in nodes if n["Alive"]),
+                "kind": "gauge", "help": "alive nodes"}]
+        for k, v in total.items():
+            if k.startswith("node:"):
+                continue
+            out.append({"name": "ray_tpu_cluster_resource_total",
+                        "tags": {"resource": k}, "value": v,
+                        "kind": "gauge", "help": "total resources"})
+            out.append({"name": "ray_tpu_cluster_resource_available",
+                        "tags": {"resource": k},
+                        "value": avail.get(k, 0), "kind": "gauge",
+                        "help": "available resources"})
+        return out
+
+
+def start_dashboard(port: int = 8265):
+    """Launch the dashboard actor; returns (handle, port).
+
+    Reference: ``ray.init`` starting the dashboard head on 8265.
+    """
+    import ray_tpu
+
+    cls = ray_tpu.remote(DashboardHead)
+    actor = cls.options(name="_DASHBOARD", lifetime="detached").remote(port)
+    ray_tpu.get(actor.ready.remote(), timeout=30)
+    return actor, port

@@ -53,6 +53,16 @@ class GPTConfig:
     remat: bool = True
     ring_attention: bool = False  # use sp-sharded ring attention if mesh has sp>1
     eps: float = 1e-5
+    # Mixture-of-experts FFN (0 = dense). Experts shard over the "ep"
+    # mesh axis; Switch-style top-1 routing with capacity dropping.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    # Pipeline parallelism: microbatches per step when the mesh has pp>1
+    # (None -> pp). Layers shard over pp; embed/head replicate.
+    pp_microbatches: Optional[int] = None
+    # Pallas flash-attention kernel (ops/flash_attention.py) for the
+    # single-device attention path; ignored when ring attention engages.
+    flash_attention: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -99,10 +109,18 @@ def param_logical_axes(cfg: GPTConfig) -> Params:
             "bo": ("layers", "embed"),
             "ln2_scale": ("layers", "embed"),
             "ln2_bias": ("layers", "embed"),
-            "w_up": ("layers", "embed", "mlp"),
-            "b_up": ("layers", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
-            "b_down": ("layers", "embed"),
+            **({
+                "wg": ("layers", "embed", None),
+                "w_up": ("layers", "experts", "embed", "mlp"),
+                "b_up": ("layers", "experts", "mlp"),
+                "w_down": ("layers", "experts", "mlp", "embed"),
+                "b_down": ("layers", "experts", "embed"),
+            } if cfg.moe_experts else {
+                "w_up": ("layers", "embed", "mlp"),
+                "b_up": ("layers", "mlp"),
+                "w_down": ("layers", "mlp", "embed"),
+                "b_down": ("layers", "embed"),
+            }),
         },
         "lnf_scale": ("embed",),
         "lnf_bias": ("embed",),
@@ -135,10 +153,19 @@ def init_params(rng: jax.Array, cfg: GPTConfig) -> Params:
             "bo": jnp.zeros((L, D), pd),
             "ln2_scale": jnp.ones((L, D), pd),
             "ln2_bias": jnp.zeros((L, D), pd),
-            "w_up": norm(keys[4], (L, D, F)),
-            "b_up": jnp.zeros((L, F), pd),
-            "w_down": norm(keys[5], (L, F, D), res_std),
-            "b_down": jnp.zeros((L, D), pd),
+            **({
+                "wg": norm(keys[6], (L, D, cfg.moe_experts)),
+                "w_up": norm(keys[4], (L, cfg.moe_experts, D, F)),
+                "b_up": jnp.zeros((L, cfg.moe_experts, F), pd),
+                "w_down": norm(keys[5], (L, cfg.moe_experts, F, D),
+                               res_std),
+                "b_down": jnp.zeros((L, cfg.moe_experts, D), pd),
+            } if cfg.moe_experts else {
+                "w_up": norm(keys[4], (L, D, F)),
+                "b_up": jnp.zeros((L, F), pd),
+                "w_down": norm(keys[5], (L, F, D), res_std),
+                "b_down": jnp.zeros((L, D), pd),
+            }),
         },
         "lnf_scale": jnp.ones((D,), pd),
         "lnf_bias": jnp.zeros((D,), pd),
@@ -186,7 +213,63 @@ def _attention(q, k, v, cfg: GPTConfig, mesh: Optional[Mesh],
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         return fn(q, k, v)
+    if cfg.flash_attention:
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
     return mha_reference(q, k, v, causal=True)
+
+
+def _moe_ffn(h: jax.Array, bp, cfg: GPTConfig, constrain) -> jax.Array:
+    """Switch-style top-1 MoE FFN (GShard dispatch/combine einsums).
+
+    Experts carry an "experts" logical axis → the ep mesh axis; the
+    dispatched [E, C, D] tensor is constrained onto ep so XLA lowers the
+    dispatch/combine einsums to all-to-all over ICI. Over-capacity tokens
+    are dropped (residual passes them through), standard Switch behavior.
+    New TPU-first work: the reference has no MoE machinery (SURVEY.md
+    §2.3 "Expert parallelism: ABSENT").
+    """
+    cd = cfg.dtype
+    B, L, D = h.shape
+    E = cfg.moe_experts
+    T = B * L
+    C = max(1, int(cfg.moe_capacity_factor * T / E))
+    x = h.reshape(T, D)
+
+    logits = jnp.einsum("td,de->te", x, bp["wg"].astype(cd))
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_gate = jnp.max(gates, axis=-1)                     # [T]
+    top_idx = jnp.argmax(gates, axis=-1)                   # [T]
+    mask = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)   # [T, E]
+    pos = jnp.cumsum(mask, axis=0) * mask                  # 1-based slot
+    mask = mask * (pos <= C)
+    pos = (pos - 1.0) * mask                               # 0-based
+    dispatch = (mask[:, :, None] *
+                jax.nn.one_hot(pos.astype(jnp.int32), C,
+                               dtype=jnp.float32) )        # [T, E, C]
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cd), x)
+    expert_in = constrain(expert_in, "experts", None, None)
+    up = jnp.einsum("ecd,edf->ecf", expert_in, bp["w_up"].astype(cd)) + \
+        bp["b_up"][:, None, :].astype(cd)
+    up = constrain(jax.nn.gelu(up), "experts", None, "mlp")
+    down = jnp.einsum("ecf,efd->ecd", up, bp["w_down"].astype(cd)) + \
+        bp["b_down"][:, None, :].astype(cd)
+    combine = (dispatch * top_gate[:, None, None]).astype(cd)
+    y = jnp.einsum("tec,ecd->td", combine, down)
+    return y.reshape(B, L, D)
+
+
+def _ffn(h, bp, cfg: GPTConfig, constrain):
+    cd = cfg.dtype
+    if cfg.moe_experts:
+        return _moe_ffn(h, bp, cfg, constrain)
+    up = jnp.einsum("bld,df->blf", h, bp["w_up"].astype(cd)) + \
+        bp["b_up"].astype(cd)
+    up = constrain(jax.nn.gelu(up), "batch", "seq", "mlp")
+    return jnp.einsum("blf,fd->bld", up, bp["w_down"].astype(cd)) + \
+        bp["b_down"].astype(cd)
 
 
 def _block(x, bp, cfg: GPTConfig, mesh: Optional[Mesh], rules: AxisRules,
@@ -213,11 +296,7 @@ def _block(x, bp, cfg: GPTConfig, mesh: Optional[Mesh], rules: AxisRules,
     x = x + constrain(proj, "batch", "seq", None)
 
     h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"], cfg.eps)
-    up = jnp.einsum("bld,df->blf", h, bp["w_up"].astype(cd)) + \
-        bp["b_up"].astype(cd)
-    up = constrain(jax.nn.gelu(up), "batch", "seq", "mlp")
-    down = jnp.einsum("blf,fd->bld", up, bp["w_down"].astype(cd)) + \
-        bp["b_down"].astype(cd)
+    down = _ffn(h, bp, cfg, constrain)
     return x + constrain(down, "batch", "seq", None)
 
 
@@ -237,15 +316,39 @@ def forward(params: Params, tokens: jax.Array, cfg: GPTConfig,
         x = with_logical_constraint(x, mesh, "batch", "seq", None,
                                     rules=rules)
 
-    block_fn = functools.partial(_block, cfg=cfg, mesh=mesh, rules=rules,
-                                 positions=positions)
-    if cfg.remat:
-        block_fn = jax.checkpoint(block_fn)
+    use_pipeline = (mesh is not None and "pp" in mesh.axis_names
+                    and mesh.shape["pp"] > 1)
+    if use_pipeline:
+        # Pipelined blocks: layers shard over pp, activations hop stages
+        # via ppermute (parallel/pipeline.py). Inside the stage shard_map
+        # there is no mesh context, so blocks run without sharding
+        # constraints and with plain attention (tp/sp compose with pp via
+        # the outer jit's param shardings on the non-layer dims).
+        from ray_tpu.parallel.pipeline import pipeline_apply, stage_scan_fn
 
-    def scan_body(carry, bp):
-        return block_fn(carry, bp), None
+        block_fn = functools.partial(_block, cfg=cfg, mesh=None,
+                                     rules=rules, positions=positions)
+        if cfg.remat:
+            block_fn = jax.checkpoint(block_fn)
+        stage = stage_scan_fn(lambda bp, h: block_fn(h, bp))
+        data_axes = tuple(a for a in ("dp", "fsdp")
+                          if a in mesh.axis_names and mesh.shape[a] > 1)
+        from jax.sharding import PartitionSpec as _P
+        data_spec = _P(None, data_axes if data_axes else None)
+        x = pipeline_apply(
+            stage, params["blocks"], x, mesh,
+            num_microbatches=cfg.pp_microbatches,
+            data_spec=data_spec)
+    else:
+        block_fn = functools.partial(_block, cfg=cfg, mesh=mesh,
+                                     rules=rules, positions=positions)
+        if cfg.remat:
+            block_fn = jax.checkpoint(block_fn)
 
-    x, _ = lax.scan(scan_body, x, params["blocks"])
+        def scan_body(carry, bp):
+            return block_fn(carry, bp), None
+
+        x, _ = lax.scan(scan_body, x, params["blocks"])
 
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.eps)
     # Tied LM head (GPT-2 style): logits in f32 for a stable softmax.

@@ -96,6 +96,8 @@ def _load_lib():
     lib.rtpu_stats.restype = None
     lib.rtpu_list.argtypes = [p, bp, u64]
     lib.rtpu_list.restype = u64
+    lib.rtpu_set_allow_evict.argtypes = [p, ctypes.c_int]
+    lib.rtpu_set_allow_evict.restype = None
     _lib = lib
     return lib
 
@@ -129,23 +131,46 @@ class PlasmaClient:
         self._map = mmap.mmap(self._fd, 0)
         self._view = memoryview(self._map)
         self._closed = False
+        # Backpressure hook: called as on_full(needed_bytes) when a create
+        # hits RTPU_OOM with eviction disabled; returning True means "space
+        # may have been freed, retry" (the CoreWorker wires this to the
+        # node manager's spill_now — reference: CreateRequestQueue spill
+        # retry in plasma/create_request_queue.h).
+        self.on_full = None
 
     # -- raw byte-level API ---------------------------------------------------
 
+    def _check_open(self) -> None:
+        if self._closed:
+            raise OSError("object store client is closed")
+
+    def set_allow_evict(self, allow: bool) -> None:
+        self._check_open()
+        self._lib.rtpu_set_allow_evict(self._handle, 1 if allow else 0)
+
     def create(self, object_id: bytes, size: int) -> memoryview:
-        off = ctypes.c_uint64()
-        rc = self._lib.rtpu_create(self._handle, object_id, size, ctypes.byref(off))
-        if rc == RTPU_EXISTS:
-            raise ObjectExistsError(object_id.hex())
-        if rc in (RTPU_OOM, RTPU_FULL_TABLE):
-            raise StoreFullError(
-                f"object store full creating {size} bytes (rc={rc})"
-            )
-        if rc != RTPU_OK:
-            raise OSError(f"create failed rc={rc}")
-        return self._view[off.value : off.value + size]
+        self._check_open()
+        attempts_left = 3
+        while True:
+            off = ctypes.c_uint64()
+            rc = self._lib.rtpu_create(self._handle, object_id, size,
+                                       ctypes.byref(off))
+            if rc == RTPU_EXISTS:
+                raise ObjectExistsError(object_id.hex())
+            if rc in (RTPU_OOM, RTPU_FULL_TABLE):
+                if rc == RTPU_OOM and self.on_full is not None \
+                        and attempts_left > 0 and self.on_full(size):
+                    attempts_left -= 1
+                    continue
+                raise StoreFullError(
+                    f"object store full creating {size} bytes (rc={rc})"
+                )
+            if rc != RTPU_OK:
+                raise OSError(f"create failed rc={rc}")
+            return self._view[off.value : off.value + size]
 
     def seal(self, object_id: bytes) -> None:
+        self._check_open()
         rc = self._lib.rtpu_seal(self._handle, object_id)
         if rc != RTPU_OK:
             raise OSError(f"seal failed rc={rc}")
@@ -158,6 +183,7 @@ class PlasmaClient:
 
         Callers must ``release`` when done with the view.
         """
+        self._check_open()
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         rc = self._lib.rtpu_get(self._handle, object_id, timeout_ms,
@@ -172,12 +198,15 @@ class PlasmaClient:
         self._lib.rtpu_release(self._handle, object_id)
 
     def delete(self, object_id: bytes) -> bool:
+        self._check_open()
         return self._lib.rtpu_delete(self._handle, object_id) == RTPU_OK
 
     def contains(self, object_id: bytes) -> bool:
+        self._check_open()
         return bool(self._lib.rtpu_contains(self._handle, object_id))
 
     def stats(self) -> dict:
+        self._check_open()
         used = ctypes.c_uint64()
         cap = ctypes.c_uint64()
         n = ctypes.c_uint64()
@@ -192,6 +221,7 @@ class PlasmaClient:
         }
 
     def list_objects(self, max_n: int = 4096) -> list:
+        self._check_open()
         buf = (ctypes.c_uint8 * (max_n * ID_SIZE))()
         n = self._lib.rtpu_list(self._handle, buf, max_n)
         raw = bytes(buf)

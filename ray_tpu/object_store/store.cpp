@@ -77,6 +77,10 @@ struct ArenaHeader {
   uint64_t lru_tail;
   uint64_t evictions;
   uint64_t created_total;
+  // When 0, create() returns RTPU_OOM instead of evicting — the node
+  // manager owns memory pressure and spills to disk first (reference:
+  // spill-before-evict in local_object_manager / create_request_queue).
+  uint32_t allow_evict;
 };
 
 struct Handle {
@@ -343,6 +347,7 @@ int rtpu_store_init(const char* path, uint64_t capacity, uint64_t max_objects) {
   hdr->mask = max_objects - 1;
   hdr->free_head = data_offset;
   hdr->used_bytes = 0;
+  hdr->allow_evict = 1;
 
   FreeBlock* first = reinterpret_cast<FreeBlock*>((uint8_t*)base + data_offset);
   first->size = capacity;
@@ -427,6 +432,7 @@ int rtpu_create(void* hv, const uint8_t* id, uint64_t size,
   uint64_t actual = 0;
   uint64_t off = alloc_data(h, size, &actual);
   if (off == kNil) {
+    if (!h->hdr->allow_evict) return RTPU_OOM;
     if (!evict_for(h, align_up(size))) return RTPU_OOM;
     off = alloc_data(h, size, &actual);
     while (off == kNil && h->hdr->lru_head) {
@@ -566,6 +572,14 @@ int rtpu_info(void* hv, const uint8_t* id, uint64_t* size_out,
   *refcount_out = e->refcount;
   *state_out = e->state;
   return RTPU_OK;
+}
+
+// Toggle LRU eviction arena-wide (0 = creates fail with RTPU_OOM under
+// pressure so the node manager can spill instead of losing data).
+void rtpu_set_allow_evict(void* hv, int allow) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  Locker lock(h->hdr);
+  h->hdr->allow_evict = allow ? 1 : 0;
 }
 
 void rtpu_stats(void* hv, uint64_t* used, uint64_t* capacity,

@@ -1,0 +1,119 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+
+No counterpart exists in the reference — Ray hosts pipeline parallelism
+in external libraries (SURVEY.md §2.3: Alpa-on-Ray release test) — so
+this is TPU-first new work: stages are the ``pp`` mesh axis inside one
+``shard_map`` program, activations hop stage-to-stage via ``ppermute``
+(one ICI hop), and microbatches fill the pipeline GPipe-style
+(P-1 bubble steps, then steady state).
+
+Layout: the stacked layer params [L, ...] are sharded over pp on the
+leading dim — stage s holds layers [s*L/P, (s+1)*L/P). Microbatches
+stream through; each loop tick every stage runs its layer block on its
+current activation, then activations rotate +1 around the ring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "pp",
+    num_microbatches: Optional[int] = None,
+    data_spec: P = P(),
+    param_spec_fn: Optional[Callable[[Any], P]] = None,
+) -> jax.Array:
+    """Run ``x`` through P pipeline stages.
+
+    stage_fn(stage_params_shard, mb) applies ONE stage's layers to a
+    microbatch [mb, ...] -> same shape. ``stage_params`` leaves must have
+    a leading layers dim divisible by P (sharded over ``axis``).
+    ``x``: [B, ...]; B must divide by num_microbatches (default P).
+    """
+    pp = mesh.shape[axis]
+    M = num_microbatches or pp
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+
+    # [M, B/M, ...] microbatch leading dim.
+    mb_shape = (M, B // M) + x.shape[1:]
+    x_mb = x.reshape(mb_shape)
+
+    def body(params, x_mb_local):
+        """Runs per-stage inside shard_map. params: this stage's layer
+        shard; x_mb_local: the full microbatch stack (replicated over pp).
+        """
+        idx = lax.axis_index(axis)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        T = M + pp - 1
+        state = jnp.zeros_like(x_mb_local[0])           # in-flight activation
+        outputs = jnp.zeros_like(x_mb_local)            # filled by last stage
+
+        def step(t, carry):
+            state, outputs = carry
+            # Stage 0 ingests microbatch t (if any remain).
+            incoming = lax.dynamic_index_in_dim(
+                x_mb_local, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+            state = jnp.where(idx == 0, incoming, state)
+            state = stage_fn(params, state)
+            # Last stage emits microbatch t-(P-1) once the fill is done.
+            out_slot = t - (pp - 1)
+            emit = jnp.logical_and(idx == pp - 1, out_slot >= 0)
+            outputs = lax.cond(
+                emit,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, state, jnp.maximum(out_slot, 0), axis=0),
+                lambda o: o,
+                outputs)
+            # Rotate activations one stage forward.
+            state = lax.ppermute(state, axis, perm)
+            return state, outputs
+
+        _, outputs = lax.fori_loop(0, T, step, (state, outputs))
+        # Only the last stage holds real outputs; broadcast them to every
+        # stage so downstream (replicated) compute sees the full result.
+        outputs = lax.psum(
+            jnp.where(idx == pp - 1, outputs, jnp.zeros_like(outputs)),
+            axis)
+        return outputs
+
+    pspec = param_spec_fn(stage_params) if param_spec_fn else None
+    if pspec is None:
+        # Default: shard every param leaf's leading (layers) dim over pp.
+        pspec = jax.tree.map(lambda _: P(axis), stage_params)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, data_spec),
+        out_specs=data_spec,
+        check_vma=False)
+    out_mb = fn(stage_params, x_mb)
+    return out_mb.reshape((B,) + x.shape[1:])
+
+
+def stage_scan_fn(layer_fn: Callable[[Any, jax.Array], jax.Array]):
+    """Lift a single-layer fn into a stage fn scanning its layer shard
+    (layers-within-stage still scan, so compile time stays O(1) in
+    depth)."""
+
+    def stage(params_shard, x):
+        def body(carry, lp):
+            return layer_fn(lp, carry), None
+
+        out, _ = lax.scan(body, x, params_shard)
+        return out
+
+    return stage

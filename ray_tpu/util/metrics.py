@@ -1,0 +1,232 @@
+"""Application metrics API (reference: ``python/ray/util/metrics.py`` —
+Counter/Gauge/Histogram over the C++ OpenCensus registry
+``stats/metric.h:103``; exported per node by ``_private/metrics_agent.py:375``
+as Prometheus text).
+
+Here: a process-local registry; each worker/driver periodically reports
+samples to the GCS (``report_metrics``), and the dashboard's ``/metrics``
+endpoint renders the cluster-wide aggregate in Prometheus exposition
+format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "_Metric"] = {}
+
+_DEFAULT_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0]
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None and existing.kind != self.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{existing.kind}")
+            _registry[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags_tuple(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        unknown = set(merged) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tags {unknown} for {self.name}")
+        return tuple((k, merged.get(k, "")) for k in self.tag_keys)
+
+    def samples(self) -> List[tuple]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._tags_tuple(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def samples(self):
+        with self._lock:
+            return [(self.name, dict(k), v)
+                    for k, v in self._values.items()]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._tags_tuple(tags)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def samples(self):
+        with self._lock:
+            return [(self.name, dict(k), v)
+                    for k, v in self._values.items()]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries=None,
+                 tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = list(boundaries or _DEFAULT_BOUNDARIES)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        key = self._tags_tuple(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def samples(self):
+        out = []
+        with self._lock:
+            for key, counts in self._counts.items():
+                tags = dict(key)
+                cum = 0
+                for b, c in zip(self.boundaries, counts):
+                    cum += c
+                    out.append((f"{self.name}_bucket",
+                                {**tags, "le": str(b)}, cum))
+                out.append((f"{self.name}_bucket",
+                            {**tags, "le": "+Inf"},
+                            cum + counts[-1]))
+                out.append((f"{self.name}_sum", tags, self._sums[key]))
+                out.append((f"{self.name}_count", tags,
+                            self._totals[key]))
+        return out
+
+
+# ------------------------------------------------------------- exposition
+
+
+def collect_samples() -> List[dict]:
+    """All local metric samples as JSON-able dicts (shipped to the GCS)."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    out = []
+    for m in metrics:
+        for name, tags, value in m.samples():
+            out.append({"name": name, "tags": tags, "value": value,
+                        "kind": m.kind, "help": m.description})
+    return out
+
+
+def prometheus_text(sample_groups: List[List[dict]]) -> str:
+    """Render sample groups (one per reporting process) as Prometheus
+    exposition text (reference: metrics_agent.py Prometheus export).
+
+    Same-name+tags series from different processes are AGGREGATED (summed
+    for counters/histogram components, last-write for gauges) and emitted
+    grouped per metric family — Prometheus rejects duplicate or
+    interleaved series.
+    """
+    # (name, tags_tuple) -> [value, kind, help]
+    merged: Dict[Tuple[str, Tuple], list] = {}
+    order: List[Tuple[str, Tuple]] = []
+    for group in sample_groups:
+        for s in group:
+            key = (s["name"], tuple(sorted(s["tags"].items())))
+            if key not in merged:
+                merged[key] = [s["value"], s.get("kind", "untyped"),
+                               s.get("help", "")]
+                order.append(key)
+            elif merged[key][1] == "gauge":
+                merged[key][0] = s["value"]
+            else:  # counters and histogram buckets/sums/counts add up
+                merged[key][0] += s["value"]
+
+    families: Dict[str, list] = {}
+    for name, tags in order:
+        base = name.removesuffix("_bucket").removesuffix(
+            "_sum").removesuffix("_count")
+        families.setdefault(base, []).append((name, tags))
+
+    lines: List[str] = []
+    for base, series in families.items():
+        _, kind, help_ = merged[series[0]]
+        lines.append(f"# HELP {base} {help_}")
+        lines.append(f"# TYPE {base} {kind}")
+        for name, tags in series:
+            value = merged[(name, tags)][0]
+            tag_str = ",".join(f'{k}="{v}"' for k, v in tags)
+            lines.append(f"{name}{{{tag_str}}} {value}"
+                         if tag_str else f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def report_to_gcs() -> bool:
+    """Push this process's samples to the GCS metrics table."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    if w is None:
+        return False
+    try:
+        w.gcs.notify("report_metrics", {
+            "client_id": w.client_id,
+            "samples": collect_samples(),
+            "ts": time.time(),
+        })
+        return True
+    except Exception:
+        return False
+
+
+def start_reporter(period_s: float = 5.0) -> threading.Thread:
+    """Background reporter thread (the per-process analog of the
+    reference's per-node metrics agent push loop)."""
+
+    def loop():
+        while True:
+            time.sleep(period_s)
+            report_to_gcs()
+
+    t = threading.Thread(target=loop, daemon=True, name="rtpu-metrics")
+    t.start()
+    return t

@@ -1,0 +1,88 @@
+"""Metrics API + dashboard REST tests."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_counter_gauge_histogram():
+    c = metrics.Counter("test_requests_total", "requests",
+                        tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = metrics.Gauge("test_temperature", "temp")
+    g.set(21.5)
+
+    h = metrics.Histogram("test_latency_seconds", "latency",
+                          boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    samples = metrics.collect_samples()
+    by_name = {}
+    for s in samples:
+        by_name.setdefault(s["name"], []).append(s)
+    assert any(s["value"] == 3.0 and s["tags"] == {"route": "/a"}
+               for s in by_name["test_requests_total"])
+    assert by_name["test_temperature"][0]["value"] == 21.5
+    buckets = {s["tags"]["le"]: s["value"]
+               for s in by_name["test_latency_seconds_bucket"]}
+    assert buckets["0.1"] == 1 and buckets["1.0"] == 2
+    assert buckets["+Inf"] == 3
+    assert by_name["test_latency_seconds_count"][0]["value"] == 3
+
+    text = metrics.prometheus_text([samples])
+    assert '# TYPE test_requests_total counter' in text
+    assert 'test_requests_total{route="/a"} 3.0' in text
+
+
+def test_metrics_report_to_gcs(ray_cluster):
+    g = metrics.Gauge("test_reported_gauge", "x")
+    g.set(7.0)
+    assert metrics.report_to_gcs()
+    from ray_tpu._private import worker as worker_mod
+
+    groups = worker_mod.require_worker().gcs.request("get_metrics")
+    flat = [s for grp in groups for s in grp]
+    assert any(s["name"] == "test_reported_gauge" and s["value"] == 7.0
+               for s in flat)
+
+
+def test_dashboard_rest(ray_cluster):
+    from ray_tpu.dashboard import start_dashboard
+
+    _actor, port = start_dashboard(port=18265)
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=15) as r:
+            return r.read().decode()
+
+    nodes = json.loads(get("/api/nodes"))
+    assert len(nodes) == 1 and nodes[0]["Alive"]
+
+    status = json.loads(get("/api/cluster_status"))
+    assert status["total"]["CPU"] == 4.0
+
+    html = get("/")
+    assert "ray_tpu" in html
+
+    prom = get("/metrics")
+    assert "ray_tpu_cluster_nodes_alive 1" in prom
+    assert 'ray_tpu_cluster_resource_total{resource="CPU"} 4.0' in prom
