@@ -1,0 +1,173 @@
+"""Autoregressive generation with a KV cache (prefill + decode).
+
+TPU-first inference path for the serve library (BASELINE.json config 5:
+Llama-class inference deployment). The decode loop is a single compiled
+``lax.scan`` over steps — static shapes (cache pre-allocated at
+``max_len``), no host round-trips per token, MXU-friendly batched
+matmuls. The reference has no in-tree generation code (it serves torch
+models); this is new work.
+
+Design:
+- The KV cache is a pytree ``{k: [L, B, T, H, Dh], v: ...}`` with a
+  ``length`` scalar; attention masks keys beyond ``length``.
+- ``prefill`` runs the full prompt through the network once (big matmuls)
+  and returns cache + last-token logits.
+- ``decode_step`` appends one token; ``generate`` scans it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.transformer import (
+    GPTConfig, Params, _layer_norm, _rope,
+)
+
+_NEG_INF = -1e30
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict[str, Any]:
+    L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
+    return {
+        "k": jnp.zeros((L, batch, max_len, H, Dh), cfg.dtype),
+        "v": jnp.zeros((L, batch, max_len, H, Dh), cfg.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attn_with_cache(q, k_cache, v_cache, cache_len, scale):
+    """q: [B, S, H, Dh] (S = new tokens); caches: [B, T, H, Dh] with the
+    new keys already written at [cache_len, cache_len+S). Causal within
+    the new block; all cached positions visible."""
+    b, s, h, d = q.shape
+    t = k_cache.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale
+    q_pos = cache_len + jnp.arange(s)[:, None]          # [S, 1]
+    k_pos = jnp.arange(t)[None, :]                      # [1, T]
+    visible = k_pos <= q_pos                            # causal + cached
+    logits = jnp.where(visible[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
+                     v_cache)
+    return out
+
+
+def _block_cached(x, bp, layer_cache, cache_len, cfg: GPTConfig,
+                  positions):
+    """One block over S new tokens, reading/writing the layer KV cache.
+    Returns (out, new_k, new_v) where new_* are the full cache rows."""
+    cd = cfg.dtype
+    scale = cfg.head_dim ** -0.5
+
+    h = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], cfg.eps)
+    qkv = jnp.einsum("bld,dshk->blshk", h, bp["wqkv"].astype(cd)) + \
+        bp["bqkv"].astype(cd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if cfg.rotary:
+        q = _rope(q, positions)
+        k = _rope(k, positions)
+    k_cache, v_cache = layer_cache
+    s = k.shape[1]
+    k_cache = lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0))
+    attn = _attn_with_cache(q, k_cache, v_cache, cache_len, scale)
+    proj = jnp.einsum("blhk,hkd->bld", attn, bp["wo"].astype(cd)) + \
+        bp["bo"].astype(cd)
+    x = x + proj
+
+    from ray_tpu.models.transformer import _ffn
+
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"], cfg.eps)
+    down = _ffn(h, bp, cfg, lambda y, *a: y)
+    return x + down, k_cache, v_cache
+
+
+def _forward_cached(params: Params, tokens: jax.Array, cache,
+                    cfg: GPTConfig) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Run S new tokens; returns (logits [B, S, V], updated cache)."""
+    cd = cfg.dtype
+    s = tokens.shape[1]
+    cache_len = cache["length"]
+    positions = cache_len + jnp.arange(s)
+
+    x = jnp.take(params["tok_embed"], tokens, axis=0).astype(cd)
+    if not cfg.rotary:
+        x = x + jnp.take(params["pos_embed"], positions,
+                         axis=0).astype(cd)
+
+    def scan_body(carry, inputs):
+        xx = carry
+        bp, (kc, vc) = inputs
+        out, nk, nv = _block_cached(xx, bp, (kc, vc), cache_len, cfg,
+                                    positions)
+        return out, (nk, nv)
+
+    x, (new_k, new_v) = lax.scan(
+        scan_body, x, (params["blocks"], (cache["k"], cache["v"])))
+
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.eps)
+    logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32),
+                        params["tok_embed"].astype(jnp.float32))
+    new_cache = {"k": new_k, "v": new_v, "length": cache_len + s}
+    return logits, new_cache
+
+
+def prefill(params: Params, prompt: jax.Array, cfg: GPTConfig,
+            max_len: int) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Process the whole prompt; returns (last-token logits [B, V],
+    cache)."""
+    b, s = prompt.shape
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = _forward_cached(params, prompt, cache, cfg)
+    return logits[:, -1], cache
+
+
+def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
+            top_k: int) -> jax.Array:
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, _NEG_INF)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "max_new_tokens", "max_len", "temperature", "top_k"))
+def generate(params: Params, prompt: jax.Array, rng: jax.Array, *,
+             cfg: GPTConfig, max_new_tokens: int,
+             max_len: Optional[int] = None,
+             temperature: float = 1.0, top_k: int = 0) -> jax.Array:
+    """Sample ``max_new_tokens`` continuations for ``prompt`` [B, S].
+
+    One compiled program: prefill + a ``lax.scan`` decode loop (no
+    per-token dispatch). Returns [B, max_new_tokens] token ids.
+    """
+    b, s = prompt.shape
+    max_len = max_len or min(cfg.max_seq, s + max_new_tokens)
+    assert s + max_new_tokens <= max_len <= cfg.max_seq
+
+    logits, cache = prefill(params, prompt, cfg, max_len)
+    rngs = jax.random.split(rng, max_new_tokens)
+    first = _sample(logits, rngs[0], temperature, top_k)
+    if max_new_tokens == 1:
+        return first[:, None]
+
+    def step(carry, step_rng):
+        token, cache = carry
+        logits, cache = _forward_cached(
+            params, token[:, None], cache, cfg)
+        nxt = _sample(logits[:, -1], step_rng, temperature, top_k)
+        return (nxt, cache), nxt  # emit the newly sampled token
+
+    _, rest = lax.scan(step, (first, cache), rngs[1:])
+    return jnp.concatenate([first[:, None], rest.transpose(1, 0)], axis=1)

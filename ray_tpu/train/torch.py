@@ -1,0 +1,94 @@
+"""Torch integration (reference: ``train/torch/torch_trainer.py`` +
+``train/torch/train_loop_utils.py:20,75`` prepare_model/DDP).
+
+TPU-framework position: JAX is the native compute path, but the
+reference's flagship trainer is torch — parity means torch users can run
+data-parallel CPU/host training on this runtime. ``prepare_model``
+replicates initial weights from rank 0; ``backward_allreduce`` averages
+gradients across the gang through the session collective group (the role
+DDP's bucketed NCCL allreduce hook plays in the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.data_parallel import DataParallelTrainer
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer whose workers run torch loops (reference:
+    ``TorchTrainer`` — always tune-driven through fit())."""
+
+    _default_backend = "store"
+
+
+def prepare_model(model, *, broadcast_parameters: bool = True):
+    """Make a torch module data-parallel-ready: broadcast rank-0 weights
+    so every worker starts identical (reference: prepare_model wrapping
+    DDP, train_loop_utils.py:75)."""
+    sess = session_mod._get_session()
+    if sess.world_size == 1:
+        return model
+    if broadcast_parameters:
+        from ray_tpu.parallel import collective
+
+        for p in model.parameters():
+            arr = p.detach().cpu().numpy()
+            out = collective.broadcast(arr, src_rank=0,
+                                       group_name=sess.collective_group_name)
+            with _no_grad():
+                p.copy_(_to_tensor(out, p))
+    return model
+
+
+def backward_allreduce(model) -> None:
+    """Average gradients across the gang after ``loss.backward()`` —
+    call once per step (the DDP allreduce equivalent)."""
+    sess = session_mod._get_session()
+    if sess.world_size == 1:
+        return
+    from ray_tpu.parallel import collective
+
+    ws = sess.world_size
+    for p in model.parameters():
+        if p.grad is None:
+            continue
+        g = p.grad.detach().cpu().numpy()
+        out = np.asarray(collective.allreduce(
+            g, group_name=sess.collective_group_name)) / ws
+        with _no_grad():
+            p.grad.copy_(_to_tensor(out, p.grad))
+
+
+def prepare_data_loader(dataset, *, batch_size: int, shuffle: bool = True,
+                        seed: int = 0):
+    """Shard a torch dataset across the gang (reference:
+    prepare_data_loader adding DistributedSampler)."""
+    import torch
+    from torch.utils.data import DataLoader, Subset
+
+    sess = session_mod._get_session()
+    n = len(dataset)
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(idx)
+    shard = idx[sess.world_rank::sess.world_size]
+    return DataLoader(Subset(dataset, shard.tolist()),
+                      batch_size=batch_size, shuffle=shuffle)
+
+
+def _no_grad():
+    import torch
+
+    return torch.no_grad()
+
+
+def _to_tensor(arr: np.ndarray, like):
+    import torch
+
+    return torch.from_numpy(np.ascontiguousarray(arr)).to(
+        dtype=like.dtype, device=like.device)
