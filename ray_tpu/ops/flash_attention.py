@@ -9,9 +9,11 @@ dimension is safe). Causal blocks above the diagonal are skipped via
 
 No counterpart exists in the reference (its attention lives in torch);
 this is the TPU hot-op path (MXU for the two matmuls, VPU for the
-softmax pieces). Backward currently runs the XLA reference
-implementation via ``jax.custom_vjp`` (numerically identical; a pallas
-backward kernel is a planned optimization).
+softmax pieces). The backward is also a pallas kernel pair
+(FlashAttention-2 recipe): the forward saves only O and the per-row
+logsumexp; the backward recomputes each probability block from Q/K/LSE
+in VMEM, so both directions are O(L) memory — no L×L tensor is ever
+materialized in HBM.
 """
 
 from __future__ import annotations
@@ -28,7 +30,22 @@ from ray_tpu.ops.attention import mha_reference
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _use_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def _causal_mask(s, qi, ki, block_q, block_k):
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(cols <= rows, s, _NEG_INF)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   num_k_blocks: int):
     qi = pl.program_id(1)
@@ -53,11 +70,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [BQ, BK]
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, _NEG_INF)
+            s = _causal_mask(s, qi, ki, block_q, block_k)
 
         m_prev = m_scr[:, 0]                          # [BQ]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -75,6 +88,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finalize():
         denom = jnp.maximum(l_scr[:, 0], 1e-30)
         o_ref[0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, 0] + jnp.log(denom)
 
 
 def _flash_forward(q3, k3, v3, *, scale, causal, block_q, block_k,
@@ -89,8 +103,7 @@ def _flash_forward(q3, k3, v3, *, scale, causal, block_q, block_k,
         block_k=block_k, num_k_blocks=nk)
     from jax.experimental.pallas import tpu as pltpu
 
-    use_tpu = jax.default_backend() == "tpu" if interpret is None \
-        else not interpret
+    interp = _use_interpret(interpret)
     return pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
@@ -99,41 +112,206 @@ def _flash_forward(q3, k3, v3, *, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, 1, lq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
             pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
             pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
         ],
-        interpret=not use_tpu,
+        interpret=interp,
     )(q3, k3, v3)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *,
+                   scale: float, causal: bool, block_q: int, block_k: int,
+                   num_k_blocks: int):
+    """dQ accumulation: grid (bh, q_block, k_block), k innermost."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = jnp.logical_or(not causal, ki <= qi)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)              # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)              # [BK, D]
+        v = v_ref[0].astype(jnp.float32)              # [BK, D]
+        do = do_ref[0].astype(jnp.float32)            # [BQ, D]
+        lse = lse_ref[0, 0]                           # [BQ]
+        delta = delta_ref[0, 0]                       # [BQ]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])                 # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    last_k = qi if causal else num_k_blocks - 1
+
+    @pl.when(ki == last_k)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    scale: float, causal: bool, block_q: int, block_k: int,
+                    num_q_blocks: int):
+    """dK/dV accumulation: grid (bh, k_block, q_block), q innermost."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = jnp.logical_or(not causal, qi >= ki)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)              # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)              # [BK, D]
+        v = v_ref[0].astype(jnp.float32)              # [BK, D]
+        do = do_ref[0].astype(jnp.float32)            # [BQ, D]
+        lse = lse_ref[0, 0]                           # [BQ]
+        delta = delta_ref[0, 0]                       # [BQ]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])                 # [BQ, BK]
+        # dV += P^T dO
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * scale
+        # dK += dS^T Q
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q3, k3, v3, do3, lse3, delta3, *, scale, causal,
+                    block_q, block_k, interpret):
+    """All shapes [BH, L, D] (lse/delta [BH, 1, L]); returns dq, dk, dv."""
+    bh, lq, d = q3.shape
+    lk = k3.shape[1]
+    nq, nk = lq // block_q, lk // block_k
+    from jax.experimental.pallas import tpu as pltpu
+
+    interp = _use_interpret(interpret)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, num_k_blocks=nk),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interp,
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    # dK/dV kernel walks q innermost: same block shapes, transposed grid.
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    rowspec2 = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, num_q_blocks=nq),
+        grid=(bh, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interp,
+    )(q3, k3, v3, do3, lse3, delta3)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
     """[B, L, H, D] flash attention core with custom VJP."""
-    b, lq, h, d = q.shape
-    scale = d ** -0.5
-    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(  # noqa: E731
-        b * h, x.shape[1], d)
-    o3 = _flash_forward(to3(q), to3(k), to3(v), scale=scale,
-                        causal=causal, block_q=block_q, block_k=block_k,
-                        interpret=interpret)
-    return o3.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _to3(x):
+    b, l, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+
+def _from3(x3, b, h):
+    bh, l, d = x3.shape
+    return x3.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    b, lq, h, d = q.shape
+    scale = d ** -0.5
+    o3, lse3 = _flash_forward(
+        _to3(q), _to3(k), _to3(v), scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return _from3(o3, b, h), (q, k, v, o3, lse3)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    # XLA reference backward (same math; memory O(L^2) — acceptable up to
-    # moderate L; pallas backward kernel planned).
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, o3, lse3 = res
+    b, lq, h, d = q.shape
+    scale = d ** -0.5
+    do3 = _to3(g)
+    # delta_i = sum_d dO_i·O_i — cheap rowwise reduce, leave it to XLA.
+    delta3 = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                     axis=-1)[:, None, :]
+    dq3, dk3, dv3 = _flash_backward(
+        _to3(q), _to3(k), _to3(v), do3, lse3, delta3, scale=scale,
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret)
+    return (_from3(dq3, b, h).astype(q.dtype),
+            _from3(dk3, b, h).astype(k.dtype),
+            _from3(dv3, b, h).astype(v.dtype))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -151,6 +329,7 @@ def flash_attention(
     lq, lk = q.shape[1], k.shape[1]
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
-    if lq % block_q or lk % block_k or (causal and block_q != block_k):
+    if (lq % block_q or lk % block_k
+            or (causal and (block_q != block_k or lq != lk))):
         return mha_reference(q, k, v, causal=causal)
     return _flash(q, k, v, causal, block_q, block_k, interpret)
