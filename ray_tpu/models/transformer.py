@@ -98,8 +98,16 @@ def param_logical_axes(cfg: GPTConfig) -> Params:
     The block params carry a leading ``layers`` axis (scanned, never
     sharded by default; a pipeline schedule may claim it).
     """
+    # tok_embed: deliberately replicated (None, None). Any sharding on the
+    # table makes the input-embedding gather unpartitionable — XLA SPMD
+    # falls back to "involuntary full rematerialization" (replicate +
+    # repartition) on every step, whether vocab is sharded over tp or embed
+    # over fsdp. With a replicated operand and batch/seq-sharded indices the
+    # gather partitions cleanly over the index dims. The tied LM head still
+    # computes vocab-parallel because the *logits* activation is constrained
+    # onto ("batch","seq","vocab"→tp) in forward().
     ax = {
-        "tok_embed": ("vocab", "embed"),
+        "tok_embed": (None, None),
         "blocks": {
             "ln1_scale": ("layers", "embed"),
             "ln1_bias": ("layers", "embed"),
