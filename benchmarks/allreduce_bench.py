@@ -1,0 +1,92 @@
+"""Allreduce microbenchmark: xla_dist (compiled cross-process XLA
+collective) vs store (object-store polling fallback).
+
+BASELINE.json config 1 ("2-worker allreduce microbenchmark vs gloo/CPU").
+Prints one JSON line per (backend, size) with effective allreduce
+bandwidth: GB/s = 2*(W-1)/W * bytes / t  (ring-allreduce wire traffic).
+
+Usage:  python benchmarks/allreduce_bench.py [--world 2] [--iters 10]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _BenchWorker:
+    def join(self, world, rank, name, backend):
+        from ray_tpu.parallel import collective
+
+        self._g = collective.init_collective_group(
+            world, rank, backend=backend, group_name=name)
+        return True
+
+    def bench(self, mbytes, iters):
+        n = int(mbytes * 1024 * 1024 / 4)
+        x = np.ones((n,), np.float32)
+        self._g.allreduce(x)  # warmup (compile/rendezvous)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            self._g.allreduce(x)
+        return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--sizes-mb", type=float, nargs="+",
+                    default=[1.0, 16.0])
+    args = ap.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=args.world * 2,
+                 object_store_memory=512 * 1024 * 1024)
+    cls = ray_tpu.remote(_BenchWorker)
+    results = []
+    try:
+        for backend in ("xla_dist", "store"):
+            workers = [cls.remote() for _ in range(args.world)]
+            ray_tpu.get([w.join.remote(args.world, r,
+                                       f"arb_{backend}", backend)
+                         for r, w in enumerate(workers)], timeout=180)
+            for mb in args.sizes_mb:
+                ts = ray_tpu.get(
+                    [w.bench.remote(mb, args.iters) for w in workers],
+                    timeout=600)
+                t = max(ts)  # group completes when the slowest rank does
+                wire = 2 * (args.world - 1) / args.world * mb / 1024
+                rec = {
+                    "metric": "allreduce_busbw_gbps",
+                    "backend": backend,
+                    "world": args.world,
+                    "size_mb": mb,
+                    "sec_per_op": round(t, 5),
+                    "value": round(wire / t, 3),
+                    "unit": "GB/s",
+                }
+                results.append(rec)
+                print(json.dumps(rec), flush=True)
+            for w in workers:
+                ray_tpu.kill(w)
+        if len(results) >= 4:
+            xla = [r for r in results if r["backend"] == "xla_dist"][-1]
+            store = [r for r in results if r["backend"] == "store"][-1]
+            print(json.dumps({
+                "metric": "allreduce_xla_over_store_speedup",
+                "value": round(store["sec_per_op"] / xla["sec_per_op"], 2),
+                "unit": "x",
+            }), flush=True)
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,11 @@ send/recv :531,:594) with TPU-native backends instead of NCCL/Gloo:
   is a compiled ``shard_map`` program over a 1-D ``ranks`` mesh, so the
   traffic rides ICI exactly as XLA schedules it. This replaces the
   reference's ``NCCLGroup`` (``collective_group/nccl_collective_group.py:127``).
+- ``xla_dist`` — multi-controller: each rank is its own OS process (worker
+  actor); ranks rendezvous a ``jax.distributed`` world through the named
+  coordinator actor and every dense collective is one compiled XLA program
+  over a mesh spanning all member processes (the true cross-process NCCL
+  analog; gloo-backed on the CPU test platform).
 - ``store`` — cross-process functional backend: ranks exchange object-store
   refs through a named coordinator actor (the analog of the reference's
   named-actor NCCL-UID rendezvous) and reduce locally. This replaces
@@ -38,7 +43,8 @@ class ReduceOp(Enum):
 
 
 class Backend(str, Enum):
-    XLA = "xla"
+    XLA = "xla"            # single-process: rank == local device
+    XLA_DIST = "xla_dist"  # multi-controller: rank == OS process
     STORE = "store"
 
 
@@ -419,6 +425,219 @@ class StoreGroup(BaseGroup):
                 pass
 
 
+# ---------------------------------------------------------------- xla_dist
+
+
+def _node_ip() -> str:
+    import socket
+
+    try:
+        # UDP connect doesn't send packets; yields the outbound interface IP.
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def join_world(coordinator_address: str, world_size: int, rank: int,
+               timeout_s: float = 120.0):
+    """Join (or confirm membership in) the process-spanning jax.distributed
+    world. Idempotent per process. Returns the 1-D one-device-per-process
+    mesh for collective programs.
+
+    The analog of the reference's NCCL communicator setup
+    (``collective_group/nccl_collective_group.py:127`` _get_nccl_communicator:
+    rendezvous on a UID, then ``nccl_util.create_nccl_communicator``); here
+    the "communicator" is the XLA runtime's global device world, and every
+    collective is a compiled program over it.
+    """
+    import jax
+
+    # Probe prior initialization WITHOUT touching jax.process_count():
+    # that call would itself initialize the (single-process) backend and
+    # make jax.distributed.initialize impossible.
+    from jax._src import distributed as _jax_distributed
+
+    already_joined = _jax_distributed.global_state.client is not None
+    if not already_joined and world_size > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=world_size,
+            process_id=rank,
+            initialization_timeout=int(timeout_s),
+        )
+    if jax.process_count() != world_size:
+        raise RuntimeError(
+            f"jax.distributed world has {jax.process_count()} processes, "
+            f"expected {world_size}. If this process ran jax computations "
+            f"before joining the group, the backend was initialized "
+            f"single-process — join the collective group before any other "
+            f"jax use in the worker.")
+    if jax.process_index() != rank:
+        raise RuntimeError(
+            f"jax process_index {jax.process_index()} != group rank {rank}")
+    from jax.sharding import Mesh
+
+    by_proc: Dict[int, Any] = {}
+    for d in sorted(jax.devices(), key=lambda d: (d.process_index, d.id)):
+        by_proc.setdefault(d.process_index, d)
+    devs = [by_proc[p] for p in sorted(by_proc)]
+    return Mesh(np.asarray(devs), ("ranks",))
+
+
+class XlaDistributedGroup(StoreGroup):
+    """Multi-controller XLA collective group: one member process per rank.
+
+    Dense collectives are single compiled XLA programs over a mesh that
+    spans every member process — on TPU the traffic rides ICI/DCN exactly
+    as XLA schedules it (the NCCL-allreduce analog); on CPU jax's
+    distributed runtime backs them with gloo. The coordinator address is
+    rendezvoused through the group's named coordinator actor (inherited
+    from StoreGroup, which also provides p2p send/recv and remains the
+    fallback path for object-typed payloads).
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        import ray_tpu
+
+        addr_key = f"jaxdist_addr:{group_name}"
+        if rank == 0:
+            addr = f"{_node_ip()}:{_free_port()}"
+            ray_tpu.get(self._coord.post.remote(addr_key, addr))
+        else:
+            deadline = time.time() + 60.0
+            while True:
+                addr = ray_tpu.get(self._coord.take.remote(addr_key))
+                if addr is not None:
+                    # Re-post for the remaining ranks.
+                    ray_tpu.get(self._coord.post.remote(addr_key, addr))
+                    break
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"group '{group_name}': no coordinator address "
+                        f"from rank 0")
+                time.sleep(0.02)
+        self.mesh = join_world(addr, world_size, rank)
+        self._local_device = self.mesh.devices.flat[rank]
+        self._cache: Dict[Any, Any] = {}
+
+    # -- compiled-op plumbing
+
+    def _global(self, x: np.ndarray):
+        """Lift this rank's array to a (W, *shape) global array sharded on
+        the ranks axis (this process contributes shard ``rank``)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        local = jax.device_put(x[None], self._local_device)
+        return jax.make_array_from_single_device_arrays(
+            (self.world_size,) + x.shape,
+            NamedSharding(self.mesh, P("ranks")), [local])
+
+    def _compiled(self, key, builder):
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = builder()
+            self._cache[key] = fn
+        return fn
+
+    def _run(self, op_name: str, x, body, out_specs=None):
+        import jax
+        import numpy as np_
+        from jax.sharding import PartitionSpec as P
+
+        x = np_.asarray(x)
+        g = self._global(x)
+        key = (op_name, x.shape, str(x.dtype))
+
+        def build():
+            return jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=P("ranks"),
+                out_specs=out_specs if out_specs is not None else P("ranks"),
+                check_vma=False))
+
+        out = self._compiled(key, build)(g)
+        return np_.asarray(out.addressable_data(0))
+
+    # -- collectives (single tensor in / single tensor out, like StoreGroup)
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(s):
+            if op == ReduceOp.SUM:
+                return lax.psum(s, "ranks")
+            if op == ReduceOp.AVG:
+                return lax.pmean(s, "ranks")
+            if op == ReduceOp.MAX:
+                return lax.pmax(s, "ranks")
+            if op == ReduceOp.MIN:
+                return lax.pmin(s, "ranks")
+            g = lax.all_gather(s, "ranks", axis=0, tiled=True)
+            return jnp.prod(g, axis=0, keepdims=True)
+
+        return self._run(f"allreduce:{op.value}", tensor, body)[0]
+
+    def allgather(self, tensor):
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(s):
+            return lax.all_gather(s, "ranks", axis=0, tiled=True)
+
+        # Replicated output: every process holds the full (W, *shape) stack.
+        return self._run("allgather", tensor, body, out_specs=P())
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        import numpy as np_
+        from jax import lax
+
+        t = np_.asarray(tensor)
+        if t.shape[0] % self.world_size:
+            raise ValueError("reducescatter dim not divisible by world size")
+        if op not in (ReduceOp.SUM, ReduceOp.AVG):
+            raise NotImplementedError(f"reducescatter op {op}")
+
+        def body(s):
+            r = lax.psum_scatter(
+                s[0], "ranks", scatter_dimension=0, tiled=True)
+            if op == ReduceOp.AVG:
+                r = r / self.world_size
+            return r[None]
+
+        return self._run(f"reducescatter:{op.value}", t, body)[0]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        from jax import lax
+
+        def body(s):
+            g = lax.all_gather(s, "ranks", axis=0, tiled=True)
+            return g[src_rank][None]
+
+        return self._run(f"broadcast:{src_rank}", tensor, body)[0]
+
+    def barrier(self):
+        self.allreduce(np.zeros((1,), np.float32))
+
+    # send/recv + destroy inherited from StoreGroup (mailbox p2p).
+
+
 # ----------------------------------------------------------------- module API
 
 
@@ -441,6 +660,8 @@ def init_collective_group(
         if backend == Backend.XLA:
             g: BaseGroup = XlaGroup(
                 world_size, rank, group_name, devices=devices)
+        elif backend == Backend.XLA_DIST:
+            g = XlaDistributedGroup(world_size, rank, group_name)
         else:
             g = StoreGroup(world_size, rank, group_name)
     except BaseException:

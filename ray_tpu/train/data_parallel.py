@@ -137,11 +137,17 @@ class JaxTrainer(DataParallelTrainer):
     test platform the "store" backend provides cross-process collectives.
     The reference analog is TorchTrainer (``train/torch/torch_trainer.py``)
     with NCCL swapped for compiled XLA collectives.
+
+    Default backend is ``xla_dist``: each worker process joins one
+    jax.distributed world and the per-step gradient allreduce is a single
+    compiled XLA collective spanning the gang (ICI/DCN on TPU pods,
+    gloo-backed on the CPU test platform). Pass ``backend="store"`` for
+    the polling object-store fallback.
     """
 
-    _default_backend = "store"
+    _default_backend = "xla_dist"
 
     def __init__(self, *args, **kwargs):
         if kwargs.pop("use_xla_backend", False):
-            kwargs.setdefault("backend", "xla")
+            kwargs.setdefault("backend", "xla_dist")
         super().__init__(*args, **kwargs)

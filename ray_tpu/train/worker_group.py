@@ -139,11 +139,11 @@ class WorkerGroup:
                 experiment_name=experiment_name)
             for i in range(num_workers)
         ]
-        # Rank 0 first: the store-backend coordinator actor is created by
-        # rank 0 and joined by the rest.
-        ray_tpu.get(self.workers[0].setup_collective.remote())
-        ray_tpu.get([w.setup_collective.remote()
-                     for w in self.workers[1:]])
+        # All ranks join concurrently: rank 0 creates the coordinator actor
+        # (the rest poll get_actor), and the xla_dist backend's
+        # jax.distributed rendezvous blocks every rank until the whole
+        # world has joined — a serial rank-0-first get would deadlock it.
+        ray_tpu.get([w.setup_collective.remote() for w in self.workers])
 
     def start(self, train_fn: Callable, config: Optional[dict],
               checkpoint: Optional[Checkpoint]):
