@@ -123,6 +123,20 @@ _d("raylet_heartbeat_period_ms", 1000, "Node -> GCS liveness report period.")
 _d("health_check_failure_threshold", 5,
    "Missed health checks before the GCS declares a node dead.")
 
+# --- distributed refcounting / lineage -------------------------------------
+_d("refcount_enabled", True,
+   "Track ObjectRef lifetimes cluster-wide and free store memory when the "
+   "last reference dies (reference: core_worker/reference_count.h:61).")
+_d("refcount_flush_ms", 100,
+   "Batch interval for shipping local ref-count deltas to the GCS.")
+_d("free_grace_s", 1.0,
+   "Seconds a zero-ref object is kept before its locations are freed "
+   "(absorbs in-flight borrower registrations, e.g. a ref pickled to "
+   "another process whose incref hasn't landed yet).")
+_d("max_lineage_reconstructions", 3,
+   "Times a lost object may be rebuilt by re-running its producing task "
+   "(reference: object_recovery_manager.h:41 + task_manager resubmit).")
+
 # --- gcs --------------------------------------------------------------------
 _d("gcs_storage", "memory", "GCS table storage backend: memory | file.")
 _d("gcs_file_storage_path", "", "Path for the file storage backend.")

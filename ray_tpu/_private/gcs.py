@@ -130,8 +130,22 @@ class GcsServer:
         self._obj_sizes: Dict[bytes, int] = {}
         self._failed_objects: Dict[bytes, Any] = {}
         self._obj_waiters: List[_ObjWaiter] = []
-        # object_id -> task that produces it (for "will it ever be ready")
+        # object_id -> task that produces it (for "will it ever be ready"
+        # and for lineage reconstruction)
         self._producing_task: Dict[bytes, bytes] = {}
+
+        # Distributed refcounting (reference: reference_count.h:61, here
+        # GCS-aggregated): oid -> {client_id: net count}. An object whose
+        # aggregate count reaches zero (and isn't pinned as a queued/running
+        # task argument) is freed after a short grace window.
+        self._refcounts: Dict[bytes, Dict[str, int]] = {}
+        self._client_refs: Dict[str, Set[bytes]] = collections.defaultdict(set)
+        self._pending_free: Dict[bytes, float] = {}       # oid -> deadline
+        self._task_arg_pins: Dict[bytes, int] = collections.defaultdict(int)
+        self._pinned_tasks: Set[bytes] = set()            # task ids holding pins
+        # Lineage: retained specs for resubmission + attempt caps.
+        self._task_specs: Dict[bytes, TaskSpec] = {}
+        self._reconstructions: Dict[bytes, int] = {}      # task_id -> attempts
 
         # task events ring buffer (reference: gcs_task_manager.h bounded store)
         self._task_events: collections.deque = collections.deque(maxlen=100_000)
@@ -167,6 +181,9 @@ class GcsServer:
                            if w.deadline is not None and now >= w.deadline]
                 for w in expired:
                     self._obj_waiters.remove(w)
+                due = [o for o, t in self._pending_free.items() if now >= t]
+                if due:
+                    self._free_now(due)
             for w in expired:
                 try:
                     w.conn.reply(w.msg_id, {
@@ -203,6 +220,7 @@ class GcsServer:
             elif role in ("driver", "worker"):
                 cid = conn.meta.get("client_id")
                 self._clients.pop(cid, None)
+                self._drop_client_refs(cid)
                 if role == "driver":
                     self._on_driver_exit(cid)
 
@@ -225,9 +243,22 @@ class GcsServer:
             return
         node.alive = False
         logger.warning("node %s died", node_id)
-        # Drop object locations on that node; fail unrecoverable objects.
+        # Drop object locations on that node. For objects whose LAST copy
+        # just died and that something still wants (live refs, task-arg
+        # pins, or parked waiters), re-run the producing task — lineage
+        # reconstruction (reference: object_recovery_manager.h:41).
         for oid, locs in list(self._obj_locations.items()):
             locs.discard(node_id)
+            sp = self._spilled_objects.get(oid)
+            if sp is not None and sp.get("node_id") == node_id:
+                self._spilled_objects.pop(oid, None)
+            if not locs:
+                wanted = (
+                    (self._refcount_total(oid) or 0) > 0
+                    or self._task_arg_pins.get(oid)
+                    or any(oid in w.pending for w in self._obj_waiters))
+                if wanted:
+                    self._try_reconstruct(oid)
         # Fail running tasks on that node (retry if budget remains).
         for tid, (spec, n) in list(self._running_tasks.items()):
             if n == node_id:
@@ -372,6 +403,10 @@ class GcsServer:
             spec.retries_left = spec.max_retries
             for rid in spec.return_ids():
                 self._producing_task[rid.binary()] = spec.task_id.binary()
+            # Retain the spec for lineage reconstruction; pin its args so
+            # refcount-zero deps can't be freed out from under it.
+            self._task_specs[spec.task_id.binary()] = spec
+            self._pin_task_args(spec)
             self._enqueue_task(spec)
             self._try_schedule()
 
@@ -512,6 +547,8 @@ class GcsServer:
                 self._add_location(oid, p["node_id"], size)
             if p["status"] == "crashed" and entry is not None:
                 self._handle_task_failure(entry[0], p.get("error", "worker died"))
+            elif entry is not None:
+                self._unpin_task_args(entry[0])
             self._try_schedule()
 
     def _handle_task_failure(self, spec: TaskSpec, reason: str):
@@ -526,6 +563,7 @@ class GcsServer:
 
     def _fail_task_objects(self, spec, reason: str):
         """Ask the owner's node to materialize error objects for the returns."""
+        self._unpin_task_args(spec)
         owner_node = self._nodes.get(getattr(spec, "owner_node", None)) or next(
             (n for n in self._nodes.values() if n.alive), None)
         ids = [r.binary() for r in spec.return_ids()]
@@ -655,20 +693,168 @@ class GcsServer:
                 deadline=(time.time() + timeout) if timeout is not None else None,
             )
             self._obj_waiters.append(w)
+            # Produced-then-lost objects (location set exists but is empty:
+            # every copy died) get lineage reconstruction. Never-produced
+            # objects are simply not ready yet — their producer (task or
+            # actor call) is still in flight.
+            kicked = False
+            for o in list(w.pending):
+                if o in self._obj_locations and not self._obj_locations[o]:
+                    self._try_reconstruct(o)
+                    kicked = True
+            if kicked:
+                self._try_schedule()
 
     def _h_free_objects(self, conn, p, msg_id):
         with self._lock:
-            ids = p["object_ids"]
-            by_node: Dict[str, List[bytes]] = collections.defaultdict(list)
-            for oid in ids:
-                for nid in self._obj_locations.pop(oid, ()):  # noqa: B909
-                    by_node[nid].append(oid)
-                self._obj_sizes.pop(oid, None)
-            for nid, oids in by_node.items():
-                node = self._nodes.get(nid)
-                if node is not None and node.alive:
-                    node.conn.notify("delete_objects", {"object_ids": oids})
+            self._free_now(p["object_ids"])
         conn.reply(msg_id, True)
+
+    def _free_now(self, ids: List[bytes]):
+        """Drop an object cluster-wide: directory entry, node copies, and —
+        once every return of the producing task is gone — its lineage spec.
+        Called with the lock held (explicit ``free`` and the zero-ref
+        deferred-free timer both land here)."""
+        by_node: Dict[str, List[bytes]] = collections.defaultdict(list)
+        for oid in ids:
+            for nid in self._obj_locations.pop(oid, ()):  # noqa: B909
+                by_node[nid].append(oid)
+            self._obj_sizes.pop(oid, None)
+            self._pending_free.pop(oid, None)
+            self._spilled_objects.pop(oid, None)
+            for cid in [c for c, s in self._client_refs.items() if oid in s]:
+                self._client_refs[cid].discard(oid)
+            self._refcounts.pop(oid, None)
+            # Lineage (_producing_task/_task_specs) is deliberately kept:
+            # a freed object may still be an input of a downstream task's
+            # reconstruction; the spec table is bounded by tasks submitted.
+        for nid, oids in by_node.items():
+            node = self._nodes.get(nid)
+            if node is not None and node.alive:
+                node.conn.notify("delete_objects", {"object_ids": oids})
+
+    # ------------------------------------------------------ ref counting
+
+    def _h_update_refcounts(self, conn, p, msg_id):
+        """Batched ref-count deltas from one client (reference role:
+        core_worker/reference_count.h:61 owner tables + borrower
+        registration, aggregated at the GCS here)."""
+        cid = p["client_id"]
+        with self._lock:
+            for oid, delta in p["deltas"].items():
+                counts = self._refcounts.setdefault(oid, {})
+                if delta:
+                    counts[cid] = counts.get(cid, 0) + delta
+                    if counts[cid] == 0:
+                        del counts[cid]
+                    self._client_refs[cid].add(oid)
+                self._maybe_schedule_free(oid)
+
+    def _refcount_total(self, oid: bytes) -> Optional[int]:
+        counts = self._refcounts.get(oid)
+        if counts is None:
+            return None  # never tracked: not eligible for auto-free
+        return sum(counts.values())
+
+    def _maybe_schedule_free(self, oid: bytes):
+        """Schedule (or cancel) the deferred free for one object."""
+        total = self._refcount_total(oid)
+        if total is None:
+            return
+        if total <= 0 and not self._task_arg_pins.get(oid):
+            from ray_tpu._private.config import config
+
+            self._pending_free.setdefault(
+                oid, time.time() + config.free_grace_s)
+        else:
+            self._pending_free.pop(oid, None)
+
+    def _drop_client_refs(self, client_id: str):
+        """A client process died: discard its contribution to every count."""
+        for oid in self._client_refs.pop(client_id, ()):  # noqa: B909
+            counts = self._refcounts.get(oid)
+            if counts is not None and counts.pop(client_id, None) is not None:
+                self._maybe_schedule_free(oid)
+
+    def _pin_task_args(self, spec):
+        tid = spec.task_id.binary()
+        if tid in self._pinned_tasks:
+            return
+        self._pinned_tasks.add(tid)
+        for d in spec.arg_deps:
+            self._task_arg_pins[d.binary()] += 1
+            self._pending_free.pop(d.binary(), None)
+
+    def _unpin_task_args(self, spec):
+        tid = spec.task_id.binary()
+        if tid not in self._pinned_tasks:
+            return
+        self._pinned_tasks.discard(tid)
+        for d in spec.arg_deps:
+            oid = d.binary()
+            n = self._task_arg_pins.get(oid, 0) - 1
+            if n <= 0:
+                self._task_arg_pins.pop(oid, None)
+            else:
+                self._task_arg_pins[oid] = n
+            self._maybe_schedule_free(oid)
+
+    # ---------------------------------------------- lineage reconstruction
+
+    def _producer_in_flight(self, tid: bytes) -> bool:
+        if tid in self._running_tasks:
+            return True
+        if any(s.task_id.binary() == tid for s in self._queued_tasks):
+            return True
+        return any(s.task_id.binary() == tid
+                   for lst in self._waiting_tasks.values() for s in lst)
+
+    def _try_reconstruct(self, oid: bytes, depth: int = 0) -> bool:
+        """Re-run the task that produced a lost object (reference:
+        core_worker/object_recovery_manager.h:41 + task resubmit,
+        task_manager.h:151). Returns False when the object is
+        unrecoverable (and marks it failed)."""
+        if self._obj_locations.get(oid) or depth > 16:
+            return True
+        if oid in self._failed_objects:
+            return False
+        tid = self._producing_task.get(oid)
+        spec = self._task_specs.get(tid) if tid else None
+        if spec is None:
+            # put() objects / actor-task returns have no replayable lineage.
+            self._failed_objects[oid] = (
+                "object lost (all copies died) and no lineage is available "
+                "to reconstruct it")
+            self._fulfill_obj_waiters(oid, failed=True)
+            return False
+        if self._producer_in_flight(tid):
+            return True
+        from ray_tpu._private.config import config
+
+        attempts = self._reconstructions.get(tid, 0)
+        if attempts >= config.max_lineage_reconstructions:
+            self._failed_objects[oid] = (
+                f"object lost; reconstruction limit "
+                f"({config.max_lineage_reconstructions}) exhausted")
+            self._fulfill_obj_waiters(oid, failed=True)
+            return False
+        self._reconstructions[tid] = attempts + 1
+        logger.info("reconstructing object %s by re-running task %s "
+                    "(attempt %d)", oid.hex()[:16],
+                    getattr(spec, "name", "") or tid.hex()[:16], attempts + 1)
+        # Rebuild lost inputs first; _enqueue_task parks on unready deps.
+        # Recurse only into deps that are genuinely gone (empty location set
+        # = every copy died; key absent but lineage known = freed earlier).
+        # A dep with no entry and no lineage has an in-flight producer.
+        for d in spec.arg_deps:
+            db = d.binary()
+            if ((db in self._obj_locations and not self._obj_locations[db])
+                    or (db not in self._obj_locations
+                        and db in self._producing_task)):
+                self._try_reconstruct(db, depth + 1)
+        self._pin_task_args(spec)
+        self._enqueue_task(spec)
+        return True
 
     # -------------------------------------------------------------- actors
 

@@ -62,6 +62,13 @@ class Conn:
         self._next_id_lock = threading.Lock()
         self._next_id = 1
         self._closed = False
+        # The socket fd is closed by the LAST thread that uses it (writer /
+        # serve loop), never by close() itself: closing an fd while another
+        # thread is blocked in recv/accept on it lets the OS recycle the fd
+        # number for a brand-new socket, and the still-blocked syscall then
+        # reads (or accepts) traffic that belongs to the new socket.
+        self._fd_refs = 1  # the writer thread
+        self._fd_lock = threading.Lock()
         self.name = name
         self.on_close: Optional[Callable[["Conn"], None]] = None
         # peer-assigned metadata, used by servers to track who this is
@@ -118,7 +125,30 @@ class Conn:
         self._send_q.append(frame)
         self._send_ev.set()
 
+    def _acquire_fd(self) -> bool:
+        with self._fd_lock:
+            if self._fd_refs <= 0:
+                return False  # fd already closed
+            self._fd_refs += 1
+            return True
+
+    def _release_fd(self):
+        with self._fd_lock:
+            self._fd_refs -= 1
+            last = self._fd_refs == 0
+        if last:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
     def _write_loop(self):
+        try:
+            self._write_loop_inner()
+        finally:
+            self._release_fd()
+
+    def _write_loop_inner(self):
         while True:
             self._send_ev.wait()
             while True:
@@ -188,6 +218,8 @@ class Conn:
 
     def serve(self) -> None:
         """Blocking receive loop (run in a dedicated thread)."""
+        if not self._acquire_fd():
+            return
         try:
             hdr = bytearray(_LEN.size)
             while not self._closed:
@@ -213,6 +245,7 @@ class Conn:
             pass
         finally:
             self.close()
+            self._release_fd()
 
     def start(self) -> threading.Thread:
         t = threading.Thread(target=self.serve, daemon=True,
@@ -231,10 +264,8 @@ class Conn:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # No _sock.close() here: the writer/serve threads release the fd
+        # when they exit (see _fd_refs) to avoid fd-number recycling races.
         with self._pending_lock:
             pending = list(self._pending.values())
             self._pending.clear()
@@ -353,6 +384,16 @@ class Server:
 
     def close(self):
         self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        # Join BEFORE closing the fd: a thread still blocked in accept(2)
+        # on this fd number would otherwise start accepting connections for
+        # whatever new listener the OS assigns the number to next — a
+        # stale server silently serving a fresh server's clients.
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5)
         try:
             self._sock.close()
         except OSError:

@@ -42,13 +42,32 @@ _INLINE_ARG_LIMIT = 512 * 1024  # larger arg blobs go through the object store
 
 class ObjectRef:
     """A future for a value in the object store (reference: ObjectID/ObjectRef
-    in _raylet.pyx). Picklable; reconnects to the ambient worker on loads."""
+    in _raylet.pyx). Picklable; reconnects to the ambient worker on loads.
 
-    __slots__ = ("_id", "_owner_hint")
+    Lifetime-tracked: construction increfs and ``__del__`` decrefs through
+    the ambient worker's ref tracker (batched to the GCS), so an object
+    whose last reference anywhere dies is freed from the store without an
+    explicit ``free()`` — including refs restored from pickles in other
+    processes (borrower registration). Reference:
+    core_worker/reference_count.h:61.
+    """
+
+    __slots__ = ("_id", "_owner_hint", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_hint: str = ""):
         self._id = object_id
         self._owner_hint = owner_hint
+        w = _global_worker
+        if w is not None and w._refs is not None:
+            w._refs.incref(object_id.binary())
+
+    def __del__(self):
+        try:
+            w = _global_worker
+            if w is not None and w._refs is not None:
+                w._refs.decref(self._id.binary())
+        except Exception:
+            pass  # interpreter shutdown
 
     def binary(self) -> bytes:
         return self._id.binary()
@@ -107,6 +126,54 @@ class _ObjArg:
         self.id_bytes = id_bytes
 
 
+class _RefTracker:
+    """Batches local ObjectRef incref/decref deltas to the GCS (the
+    owner-table half of reference_count.h:61, aggregated centrally)."""
+
+    def __init__(self, worker: "CoreWorker"):
+        from ray_tpu._private.config import config
+
+        self._worker = worker
+        self._pending: Dict[bytes, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._interval = max(0.01, config.refcount_flush_ms / 1000.0)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="rtpu-refcount")
+        self._thread.start()
+
+    def incref(self, oid: bytes):
+        with self._lock:
+            self._pending[oid] = self._pending.get(oid, 0) + 1
+
+    def decref(self, oid: bytes):
+        with self._lock:
+            self._pending[oid] = self._pending.get(oid, 0) - 1
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            deltas = dict(self._pending)
+            self._pending.clear()
+        # Net-zero deltas are still sent: they tell the GCS this object was
+        # referenced at all (creating its count entry), so a ref born and
+        # dropped within one flush window still becomes free-eligible.
+        if not deltas:
+            return
+        try:
+            self._worker.gcs.notify("update_refcounts", {
+                "client_id": self._worker.client_id, "deltas": deltas})
+        except Exception:
+            pass  # disconnecting; the GCS drops our counts anyway
+
+    def stop(self):
+        self._stop.set()
+        self.flush()
+
+
 class _TaskContext(threading.local):
     def __init__(self):
         self.task_id: Optional[TaskID] = None
@@ -130,6 +197,7 @@ class CoreWorker:
     ):
         self.role = role
         self.client_id = client_id or uuid.uuid4().hex
+        self._refs: Optional[_RefTracker] = None  # set after wiring completes
         self.gcs = protocol.connect(gcs_address, handler=self._on_gcs_msg,
                                     name=f"{role}-gcs")
         self.gcs_address = gcs_address
@@ -172,6 +240,10 @@ class CoreWorker:
         self._route_exec = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="rtpu-actor-route")
         self._closed = False
+        from ray_tpu._private.config import config as _cfg
+
+        if _cfg.refcount_enabled:
+            self._refs = _RefTracker(self)
 
     def _route_submit(self, fn, *args):
         try:
@@ -225,6 +297,9 @@ class CoreWorker:
         if self._closed:
             return
         self._closed = True
+        if self._refs is not None:
+            self._refs.stop()
+            self._refs = None
         self._route_exec.shutdown(wait=False)
         try:
             self.gcs.close()

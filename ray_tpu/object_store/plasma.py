@@ -125,7 +125,10 @@ class PlasmaClient:
         self._path = path
         self._handle = self._lib.rtpu_store_attach(path.encode())
         if not self._handle:
-            raise OSError(f"failed to attach to object store at {path}")
+            detail = "file missing" if not os.path.exists(path) else \
+                f"file present, {os.path.getsize(path)} bytes"
+            raise OSError(
+                f"failed to attach to object store at {path} ({detail})")
         # Map the arena file for zero-copy buffer access from Python.
         self._fd = os.open(path, os.O_RDWR)
         self._map = mmap.mmap(self._fd, 0)
