@@ -140,6 +140,9 @@ _d("max_lineage_reconstructions", 3,
 # --- gcs --------------------------------------------------------------------
 _d("gcs_storage", "memory", "GCS table storage backend: memory | file.")
 _d("gcs_file_storage_path", "", "Path for the file storage backend.")
+_d("gcs_recovery_grace_s", 10.0,
+   "After a GCS restart, how long restored actors wait for their node to "
+   "re-register before being treated as node-dead (restart budget applies).")
 _d("maximum_gcs_dead_node_cache", 100, "Dead nodes kept for the state API.")
 _d("task_events_max_buffer", 10000, "Per-worker task event buffer entries.")
 

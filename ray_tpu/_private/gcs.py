@@ -60,6 +60,7 @@ class NodeEntry:
     is_head: bool = False
     alive: bool = True
     started_at: float = field(default_factory=time.time)
+    last_heartbeat: float = field(default_factory=time.time)
 
 
 @dataclass
@@ -97,8 +98,24 @@ class _ObjWaiter:
 class GcsServer:
     """The head control-plane service."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 storage_path: Optional[str] = None):
+        from ray_tpu._private.config import config as _cfg
+
         self._lock = threading.RLock()
+        # Durable table storage (reference: redis_store_client.h:28 +
+        # GcsInitData restore). Enabled by passing storage_path or setting
+        # gcs_storage=file + gcs_file_storage_path.
+        if storage_path is None and _cfg.gcs_storage == "file":
+            storage_path = _cfg.gcs_file_storage_path or None
+        self._storage = None
+        if storage_path:
+            from ray_tpu._private.gcs_storage import GcsStorage
+
+            self._storage = GcsStorage(storage_path)
+        # Actors restored from storage that await their node's re-report.
+        self._recovering_actors: Dict[bytes, float] = {}
+        self._last_tick = time.time()
         self._nodes: Dict[str, NodeEntry] = {}
         self._clients: Dict[str, protocol.Conn] = {}
         self._client_jobs: Dict[str, JobID] = {}
@@ -151,6 +168,8 @@ class GcsServer:
         self._task_events: collections.deque = collections.deque(maxlen=100_000)
 
         self._shutdown = threading.Event()
+        if self._storage is not None:
+            self._load_from_storage()
         self.server = protocol.Server(self._handle, host=host, port=port,
                                       name="gcs")
         self.server.on_disconnect = self._on_disconnect
@@ -172,6 +191,18 @@ class GcsServer:
             except Exception:
                 pass
         self.server.close()
+        if self._storage is not None:
+            self._storage.close()
+
+    def crash_for_test(self):
+        """Chaos hook: die like ``kill -9`` — stop serving and drop every
+        connection WITHOUT the graceful shutdown notifications (nodes keep
+        their worker pools and rejoin the restarted head). Reference role:
+        the GCS-failover release tests killing gcs_server."""
+        self._shutdown.set()
+        self.server.close()
+        if self._storage is not None:
+            self._storage.close()
 
     def _timer_loop(self):
         while not self._shutdown.wait(0.05):
@@ -184,6 +215,9 @@ class GcsServer:
                 due = [o for o, t in self._pending_free.items() if now >= t]
                 if due:
                     self._free_now(due)
+                self._check_health(now)
+                if self._recovering_actors:
+                    self._expire_recovering_actors(now)
             for w in expired:
                 try:
                     w.conn.reply(w.msg_id, {
@@ -193,6 +227,118 @@ class GcsServer:
                     })
                 except Exception:
                     pass
+
+    # ------------------------------------------- persistence + fault tolerance
+
+    def _persist(self, table: str, key: bytes, value: Any):
+        if self._storage is not None:
+            try:
+                self._storage.put(table, key, value)
+            except Exception:
+                logger.exception("gcs storage put failed (%s)", table)
+
+    def _persist_delete(self, table: str, key: bytes):
+        if self._storage is not None:
+            try:
+                self._storage.delete(table, key)
+            except Exception:
+                pass
+
+    def _persist_actor(self, aid: bytes):
+        entry = self._actors.get(aid)
+        if entry is None or self._storage is None:
+            return
+        self._persist("actors", aid, {
+            "spec": entry.spec, "state": entry.state,
+            "node_id": entry.node_id, "restarts_left": entry.restarts_left,
+            "num_restarts": entry.num_restarts,
+            "death_cause": entry.death_cause,
+        })
+
+    def _load_from_storage(self):
+        """Rebuild tables after a head restart (reference: GcsInitData).
+
+        Actors that were ALIVE/pending are marked RESTARTING and wait a
+        grace period for their node to re-register and re-report them; a
+        node that never rejoins is treated as dead (restart budget applies).
+        """
+        from ray_tpu._private.config import config as _cfg
+
+        st = self._storage
+        for key, value in st.load_table("kv").items():
+            ns, _, k = key.partition(b"\x00")
+            self._kv[ns.decode()][k] = value
+        self._functions.update(
+            {k.decode(): v for k, v in st.load_table("functions").items()})
+        for k, v in st.load_table("jobs").items():
+            self._jobs[k.decode()] = v
+            try:
+                self._next_job = max(self._next_job,
+                                     int.from_bytes(bytes.fromhex(
+                                         v["job_id"]), "little"))
+            except Exception:
+                pass
+        grace = time.time() + float(
+            getattr(_cfg, "gcs_recovery_grace_s", 10.0))
+        for aid, snap in st.load_table("actors").items():
+            entry = ActorEntry(
+                spec=snap["spec"], state=snap["state"],
+                node_id=None, restarts_left=snap["restarts_left"],
+                num_restarts=snap["num_restarts"],
+                death_cause=snap["death_cause"])
+            if entry.state not in (DEAD,):
+                entry.state = RESTARTING
+                self._recovering_actors[aid] = grace
+            self._actors[aid] = entry
+            if entry.spec.name and entry.state != DEAD:
+                self._named_actors[(entry.spec.namespace,
+                                    entry.spec.name)] = aid
+        if self._actors:
+            logger.info(
+                "gcs restart: restored %d actors (%d awaiting node "
+                "re-report), %d kv namespaces, %d functions",
+                len(self._actors), len(self._recovering_actors),
+                len(self._kv), len(self._functions))
+
+    def _check_health(self, now: float):
+        """Active failure detection (reference:
+        gcs_health_check_manager.h:39): a node whose heartbeats stop for
+        threshold*period while its socket stays open is marked dead."""
+        from ray_tpu._private.config import config as _cfg
+
+        period = _cfg.raylet_heartbeat_period_ms / 1000.0
+        budget = max(_cfg.health_check_failure_threshold * period, 2.0)
+        # If the GCS itself was descheduled (compile pauses in test
+        # processes), don't blame the nodes for the gap.
+        gap = now - self._last_tick
+        if gap > 2 * period:
+            for n in self._nodes.values():
+                n.last_heartbeat += gap
+        self._last_tick = now
+        for node_id, n in list(self._nodes.items()):
+            if n.alive and now - n.last_heartbeat > budget:
+                logger.warning("node %s failed health checks "
+                               "(no heartbeat for %.1fs)", node_id,
+                               now - n.last_heartbeat)
+                self._mark_node_dead(node_id)
+
+    def _h_heartbeat(self, conn, p, msg_id):
+        with self._lock:
+            node = self._nodes.get(p["node_id"])
+            if node is not None:
+                node.last_heartbeat = time.time()
+
+    def _expire_recovering_actors(self, now: float):
+        due = [aid for aid, t in self._recovering_actors.items() if now >= t]
+        for aid in due:
+            self._recovering_actors.pop(aid, None)
+            entry = self._actors.get(aid)
+            if entry is not None and entry.state == RESTARTING \
+                    and entry.node_id is None:
+                # Node never rejoined: equivalent to node death.
+                if not self._schedule_actor(entry):
+                    self._queued_tasks.append(_ActorCreationShim(entry))
+                self._persist_actor(aid)
 
     # ------------------------------------------------------------- dispatch
 
@@ -277,7 +423,16 @@ class GcsServer:
             conn.meta["role"] = p["role"]
             conn.meta["client_id"] = cid
             self._clients[cid] = conn
-            if p["role"] == "driver":
+            if p["role"] == "driver" and p.get("existing_job") is not None:
+                # Reconnect after a GCS restart: keep the same job identity.
+                job = p["existing_job"]
+                self._client_jobs[cid] = job
+                self._jobs.setdefault(job.hex(), {
+                    "job_id": job.hex(), "driver_client_id": cid,
+                    "state": "RUNNING", "start_time": time.time(),
+                    "end_time": None,
+                })
+            elif p["role"] == "driver":
                 self._next_job += 1
                 job = JobID.from_int(self._next_job)
                 self._client_jobs[cid] = job
@@ -286,6 +441,8 @@ class GcsServer:
                     "state": "RUNNING", "start_time": time.time(),
                     "end_time": None,
                 }
+                self._persist("jobs", job.hex().encode(),
+                              self._jobs[job.hex()])
             else:
                 job = p.get("job_id")
             head = next((n for n in self._nodes.values() if n.is_head), None)
@@ -310,6 +467,21 @@ class GcsServer:
             conn.meta["role"] = "node"
             conn.meta["node_id"] = p["node_id"]
             self._nodes[p["node_id"]] = entry
+            # Rejoin after a GCS restart: the node re-reports its store
+            # contents and the actors still alive in its worker pool, so
+            # restored RESTARTING actors snap back to ALIVE without losing
+            # their state (reference: gcs_actor_manager.h restart recovery).
+            for oid, size in p.get("objects", []):
+                self._add_location(oid, p["node_id"], size)
+            for aid in p.get("actors", []):
+                a = self._actors.get(aid)
+                if a is not None and a.state != DEAD and a.node_id is None:
+                    a.state = ALIVE
+                    a.node_id = p["node_id"]
+                    entry.available.acquire(a.spec.resources)
+                    self._recovering_actors.pop(aid, None)
+                    self._persist_actor(aid)
+                    self._reply_actor_waiters(a)
             conn.reply(msg_id, {"ok": True})
             self._try_schedule()
             self._try_schedule_pgs()
@@ -350,7 +522,9 @@ class GcsServer:
 
     def _h_put_function(self, conn, p, msg_id):
         with self._lock:
-            self._functions.setdefault(p["key"], p["blob"])
+            if p["key"] not in self._functions:
+                self._functions[p["key"]] = p["blob"]
+                self._persist("functions", p["key"].encode(), p["blob"])
         conn.reply(msg_id, True)
 
     def _h_get_function(self, conn, p, msg_id):
@@ -367,6 +541,8 @@ class GcsServer:
                 conn.reply(msg_id, False)
                 return
             ns[p["key"]] = p["value"]
+            self._persist("kv", p.get("ns", "").encode() + b"\x00" + p["key"],
+                          p["value"])
         conn.reply(msg_id, True)
 
     def _h_kv_get(self, conn, p, msg_id):
@@ -375,8 +551,11 @@ class GcsServer:
 
     def _h_kv_del(self, conn, p, msg_id):
         with self._lock:
-            conn.reply(msg_id,
-                       self._kv[p.get("ns", "")].pop(p["key"], None) is not None)
+            existed = self._kv[p.get("ns", "")].pop(p["key"], None) is not None
+            if existed:
+                self._persist_delete(
+                    "kv", p.get("ns", "").encode() + b"\x00" + p["key"])
+            conn.reply(msg_id, existed)
 
     def _h_kv_exists(self, conn, p, msg_id):
         with self._lock:
@@ -873,6 +1052,7 @@ class GcsServer:
             self._actors[spec.actor_id.binary()] = entry
             if not self._schedule_actor(entry):
                 self._queued_tasks.append(_ActorCreationShim(entry))
+            self._persist_actor(spec.actor_id.binary())
             conn.reply(msg_id, {"ok": True})
 
     def _schedule_actor(self, entry: ActorEntry) -> bool:
@@ -911,6 +1091,7 @@ class GcsServer:
             state = p["state"]
             if state == ALIVE:
                 entry.state = ALIVE
+                self._persist_actor(aid)
                 self._reply_actor_waiters(entry)
             elif state == DEAD:
                 if p.get("creation_failed"):
@@ -944,6 +1125,7 @@ class GcsServer:
             entry.state = DEAD
             entry.death_cause = cause
             self._reply_actor_waiters(entry)
+        self._persist_actor(aid)
 
     def _reply_actor_waiters(self, entry: ActorEntry):
         waiters, entry.waiters = entry.waiters, []

@@ -112,6 +112,9 @@ class NodeManager:
         self.address = self.server.address
 
         # Client connection to the GCS.
+        self._labels = labels or {}
+        self._is_head = is_head
+        self._node_name = node_name
         self.gcs = protocol.connect(gcs_address, handler=self._handle_gcs,
                                     name=f"nm-gcs-{node_name}")
         self.gcs.request("register_node", {
@@ -119,9 +122,13 @@ class NodeManager:
             "address": self.address,
             "store_path": self.store_path,
             "resources": total,
-            "labels": labels or {},
+            "labels": self._labels,
             "is_head": is_head,
         })
+        # Rejoin a restarted GCS (reference: raylet re-registration after
+        # GCS failover): on conn drop, redial the same address and
+        # re-register with a re-report of live actors + store contents.
+        self.gcs.on_close = self._on_gcs_disconnect
         # Object spilling (reference: LocalObjectManager spill/restore,
         # raylet/local_object_manager.h:41 + _private/external_storage.py).
         from ray_tpu._private.external_storage import create_storage
@@ -150,6 +157,10 @@ class NodeManager:
                                          daemon=True,
                                          name="rtpu-nm-spill")
         self._spiller.start()
+        self._heartbeater = threading.Thread(target=self._heartbeat_loop,
+                                             daemon=True,
+                                             name="rtpu-nm-heartbeat")
+        self._heartbeater.start()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -187,6 +198,71 @@ class NodeManager:
             os.unlink(self.store_path)
         except OSError:
             pass
+
+    def _heartbeat_loop(self):
+        """Periodic liveness report (reference: raylet heartbeats feeding
+        gcs_health_check_manager.h:39). A wedged-but-connected node stops
+        heartbeating and the GCS declares it dead."""
+        period = max(0.05, config.raylet_heartbeat_period_ms / 1000.0)
+        while not self._shutdown:
+            time.sleep(period)
+            try:
+                self.gcs.notify("heartbeat", {"node_id": self.node_id})
+            except Exception:
+                pass  # disconnected; the rejoin path owns recovery
+
+    def _on_gcs_disconnect(self, conn):
+        if self._shutdown:
+            return
+        threading.Thread(target=self._rejoin_gcs, daemon=True,
+                         name="rtpu-nm-rejoin").start()
+
+    def _rejoin_gcs(self):
+        deadline = time.time() + 300.0
+        while not self._shutdown and time.time() < deadline:
+            try:
+                conn = protocol.connect(self.gcs_address,
+                                        handler=self._handle_gcs,
+                                        name=f"nm-gcs-{self._node_name}",
+                                        timeout=5.0)
+            except ConnectionError:
+                time.sleep(0.5)
+                continue
+            with self._lock:
+                alive_actors = [aid for aid, w in self._actors.items()
+                                if w.proc.poll() is None]
+            try:
+                objects = [(oid, 0) for oid in self.store.list_objects()]
+            except Exception:
+                objects = []
+            try:
+                conn.request("register_node", {
+                    "node_id": self.node_id,
+                    "address": self.address,
+                    "store_path": self.store_path,
+                    "resources": dict(self._total_resources),
+                    "labels": self._labels,
+                    "is_head": self._is_head,
+                    "actors": alive_actors,
+                    "objects": objects,
+                }, timeout=30)
+            except Exception:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                time.sleep(0.5)
+                continue
+            conn.on_close = self._on_gcs_disconnect
+            self.gcs = conn
+            logger.info("node %s rejoined gcs (%d actors, %d objects "
+                        "re-reported)", self.node_id[:12], len(alive_actors),
+                        len(objects))
+            return
+        if not self._shutdown:
+            logger.error("node %s could not rejoin the gcs; shutting down",
+                         self.node_id[:12])
+            self.shutdown()
 
     def _reap_loop(self):
         """Detect dead worker processes even if their socket lingers."""

@@ -174,6 +174,72 @@ class _RefTracker:
         self.flush()
 
 
+class _GcsChannel:
+    """Auto-reconnecting GCS client connection.
+
+    A dropped connection (GCS crash + restart, reference: GCS fault
+    tolerance via gcs_rpc_client retry) is redialed on the next call; the
+    client re-registers under its existing identity (drivers keep their
+    job id via ``existing_job``) and the operation is retried once.
+    """
+
+    def __init__(self, address: str, handler, name: str):
+        self._address = address
+        self._handler = handler
+        self._name = name
+        self._conn = protocol.connect(address, handler=handler, name=name)
+        self._lock = threading.Lock()
+        self._register_payload: Optional[dict] = None
+        self._closed = False
+
+    def set_reconnect_registration(self, payload: dict):
+        self._register_payload = payload
+
+    def _reconnect(self, dead_conn) -> protocol.Conn:
+        with self._lock:
+            if self._closed:
+                raise protocol.ConnectionClosed()
+            if self._conn is not dead_conn and not self._conn.closed:
+                return self._conn  # another thread already reconnected
+            conn = protocol.connect(self._address, handler=self._handler,
+                                    name=self._name, timeout=30)
+            if self._register_payload is not None:
+                conn.request("register_client", self._register_payload,
+                             timeout=30)
+            self._conn = conn
+            return conn
+
+    def _call(self, fn_name: str, *args, **kwargs):
+        conn = self._conn
+        try:
+            return getattr(conn, fn_name)(*args, **kwargs)
+        except (protocol.ConnectionClosed, OSError):
+            if self._closed or self._register_payload is None:
+                raise
+            conn2 = self._reconnect(conn)
+            return getattr(conn2, fn_name)(*args, **kwargs)
+
+    def request(self, *args, **kwargs):
+        return self._call("request", *args, **kwargs)
+
+    def request_nowait(self, *args, **kwargs):
+        return self._call("request_nowait", *args, **kwargs)
+
+    def notify(self, *args, **kwargs):
+        return self._call("notify", *args, **kwargs)
+
+    def flush(self, *args, **kwargs):
+        return self._conn.flush(*args, **kwargs)
+
+    @property
+    def closed(self) -> bool:
+        return self._conn.closed
+
+    def close(self):
+        self._closed = True
+        self._conn.close()
+
+
 class _TaskContext(threading.local):
     def __init__(self):
         self.task_id: Optional[TaskID] = None
@@ -198,8 +264,8 @@ class CoreWorker:
         self.role = role
         self.client_id = client_id or uuid.uuid4().hex
         self._refs: Optional[_RefTracker] = None  # set after wiring completes
-        self.gcs = protocol.connect(gcs_address, handler=self._on_gcs_msg,
-                                    name=f"{role}-gcs")
+        self.gcs = _GcsChannel(gcs_address, self._on_gcs_msg,
+                               name=f"{role}-gcs")
         self.gcs_address = gcs_address
         reply = self.gcs.request("register_client", {
             "client_id": self.client_id,
@@ -207,6 +273,13 @@ class CoreWorker:
             "job_id": job_id,
         })
         self.job_id: JobID = reply["job_id"] if role == "driver" else job_id
+        # Survive a GCS restart: later calls re-register with the same
+        # identity (drivers keep their job id).
+        self.gcs.set_reconnect_registration({
+            "client_id": self.client_id, "role": role,
+            "job_id": self.job_id,
+            "existing_job": self.job_id if role == "driver" else None,
+        })
         self.node_id = node_id or reply["head_node_id"]
         store_path = store_path or reply["head_store_path"]
         if store_path is None:
