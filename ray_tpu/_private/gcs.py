@@ -414,6 +414,9 @@ class GcsServer:
         for aid, entry in self._actors.items():
             if entry.node_id == node_id and entry.state in (ALIVE, PENDING_CREATION):
                 self._on_actor_down(aid, "node died")
+        # Retried tasks and restarting actors were re-enqueued above —
+        # dispatch them onto the surviving nodes now.
+        self._try_schedule()
 
     # --------------------------------------------------------- registration
 
@@ -422,6 +425,7 @@ class GcsServer:
             cid = p["client_id"]
             conn.meta["role"] = p["role"]
             conn.meta["client_id"] = cid
+            conn.meta["log_to_driver"] = bool(p.get("log_to_driver"))
             self._clients[cid] = conn
             if p["role"] == "driver" and p.get("existing_job") is not None:
                 # Reconnect after a GCS restart: keep the same job identity.
@@ -1378,6 +1382,21 @@ class GcsServer:
                          "node_id": b.node_id} for b in e.spec.bundles],
                 }
             conn.reply(msg_id, out)
+
+    # ----------------------------------------------------------- worker logs
+
+    def _h_worker_logs(self, conn, p, msg_id):
+        """Fan worker log lines out to drivers that registered with
+        log_to_driver (reference: log_monitor publishing via GCS pubsub,
+        _private/log_monitor.py:104)."""
+        with self._lock:
+            targets = [c for c in self._clients.values()
+                       if c.meta.get("log_to_driver")]
+        for c in targets:
+            try:
+                c.notify("driver_logs", p)
+            except Exception:
+                pass
 
     # ------------------------------------------------------- task events
 

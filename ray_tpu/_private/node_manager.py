@@ -63,6 +63,9 @@ class WorkerHandle:
     pending_pushes: List[tuple] = field(default_factory=list)
     killed_by_us: bool = False
     no_restart_kill: bool = False
+    log_paths: Dict[str, str] = field(default_factory=dict)   # stream -> path
+    log_offsets: Dict[str, int] = field(default_factory=dict)
+    logs_done: bool = False        # dead + fully drained
 
 
 class NodeManager:
@@ -161,6 +164,11 @@ class NodeManager:
                                              daemon=True,
                                              name="rtpu-nm-heartbeat")
         self._heartbeater.start()
+        self._log_watch: Dict[bytes, WorkerHandle] = {}
+        self._log_monitor = threading.Thread(target=self._log_monitor_loop,
+                                             daemon=True,
+                                             name="rtpu-nm-logmon")
+        self._log_monitor.start()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -198,6 +206,56 @@ class NodeManager:
             os.unlink(self.store_path)
         except OSError:
             pass
+
+    def _log_monitor_loop(self):
+        """Tail worker log files and stream new lines to the GCS
+        (reference: _private/log_monitor.py:104 LogMonitor)."""
+        while not self._shutdown:
+            time.sleep(0.2)
+            with self._lock:
+                for w in self._workers.values():
+                    self._log_watch.setdefault(w.worker_id, w)
+            entries = []
+            for wid, w in list(self._log_watch.items()):
+                dead = w.proc.poll() is not None
+                for stream, path in w.log_paths.items():
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue
+                    off = w.log_offsets.get(stream, 0)
+                    if size <= off:
+                        continue
+                    try:
+                        with open(path, "rb") as f:
+                            f.seek(off)
+                            data = f.read(min(size - off, 1 << 20))
+                    except OSError:
+                        continue
+                    # Only complete lines; leave the partial tail for later.
+                    cut = data.rfind(b"\n")
+                    if cut < 0 and not dead:
+                        continue
+                    chunk = data if dead else data[:cut + 1]
+                    w.log_offsets[stream] = off + len(chunk)
+                    lines = [ln.decode("utf-8", "replace")
+                             for ln in chunk.splitlines()]
+                    if lines:
+                        entries.append({"pid": w.proc.pid,
+                                        "worker_id": wid.hex()[:12],
+                                        "stream": stream, "lines": lines})
+                if dead and all(
+                        w.log_offsets.get(st, 0) >= (
+                            os.path.getsize(pa)
+                            if os.path.exists(pa) else 0)
+                        for st, pa in w.log_paths.items()):
+                    self._log_watch.pop(wid, None)
+            if entries:
+                try:
+                    self.gcs.notify("worker_logs", {
+                        "node_id": self.node_id, "entries": entries})
+                except Exception:
+                    pass
 
     def _heartbeat_loop(self):
         """Periodic liveness report (reference: raylet heartbeats feeding
@@ -278,7 +336,10 @@ class NodeManager:
 
     def _spawn_worker(self, dedicated: bool = False,
                       env_extra: Optional[Dict[str, str]] = None,
-                      tpu_chips: Optional[List[int]] = None) -> WorkerHandle:
+                      tpu_chips: Optional[List[int]] = None,
+                      cwd: Optional[str] = None,
+                      extra_pythonpath: Optional[List[str]] = None
+                      ) -> WorkerHandle:
         worker_id = WorkerID.from_random().binary()
         env = dict(os.environ)
         if not tpu_chips:
@@ -292,7 +353,8 @@ class NodeManager:
         # modules) by importing the same modules, so they need the driver's
         # import roots (reference: runtime_env working_dir ships driver code
         # to workers; same-host equivalent is sharing sys.path).
-        roots = [p for p in sys.path if p and os.path.isdir(p)]
+        roots = list(extra_pythonpath or [])
+        roots += [p for p in sys.path if p and os.path.isdir(p)]
         prior = env.get("PYTHONPATH")
         if prior:
             roots.append(prior)
@@ -307,13 +369,28 @@ class NodeManager:
             # Restrict the worker's XLA client to its assigned chips.
             env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
             env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,{len(tpu_chips)},1"
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env,
-            cwd=os.getcwd(),
-        )
+        # Worker stdout/stderr -> per-worker session log files (reference:
+        # default_worker.py redirection + log_monitor.py:104 tailing); the
+        # node's log monitor streams new lines to the GCS, which forwards
+        # them to drivers that asked for log_to_driver.
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        wid12 = worker_id.hex()[:12]
+        out_path = os.path.join(log_dir, f"worker-{wid12}.out")
+        err_path = os.path.join(log_dir, f"worker-{wid12}.err")
+        with open(out_path, "ab") as f_out, open(err_path, "ab") as f_err:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=env,
+                cwd=cwd or os.getcwd(),
+                stdout=f_out,
+                stderr=f_err,
+            )
         handle = WorkerHandle(worker_id=worker_id, proc=proc,
-                              dedicated=dedicated, tpu_chips=tpu_chips or [])
+                              dedicated=dedicated, tpu_chips=tpu_chips or [],
+                              log_paths={"stdout": out_path,
+                                         "stderr": err_path},
+                              log_offsets={"stdout": 0, "stderr": 0})
         with self._lock:
             self._workers[worker_id] = handle
         return handle
@@ -341,18 +418,40 @@ class NodeManager:
             tasks = dict(w.current_tasks)
             w.current_tasks.clear()
             actor_id = w.actor_id
-        # Fail in-flight tasks: write error objects, report crashed.
+        # Fail in-flight tasks. Plain tasks: report crashed WITHOUT
+        # materializing error objects — the GCS owns the retry budget, and
+        # an early error object would fulfill the caller's get() with the
+        # crash while the retry is still running (the GCS materializes
+        # errors via store_error_objects only at FINAL failure). Actor
+        # tasks: honor max_task_retries by rerouting the spec back through
+        # the GCS (parked for the restarting actor, re-executed in order);
+        # only an exhausted budget stores the actor error.
+        max_task_retries = 0
+        if w.actor_spec is not None:
+            max_task_retries = getattr(w.actor_spec, "max_task_retries", 0)
         for tid, spec in tasks.items():
-            if isinstance(spec, (TaskSpec, ActorTaskSpec)):
-                err = exceptions.WorkerCrashedError(
-                    f"worker running {getattr(spec, 'name', '')} died "
-                    f"(exit code {w.proc.poll()})")
-                if isinstance(spec, ActorTaskSpec):
-                    err = exceptions.RayActorError(
-                        actor_id=spec.actor_id.hex(), msg="actor died")
+            if isinstance(spec, ActorTaskSpec):
+                left = getattr(spec, "retries_left", None)
+                if left is None:
+                    left = max_task_retries
+                if left != 0:
+                    spec.retries_left = left - 1 if left > 0 else left
+                    try:
+                        self.gcs.notify("reroute_actor_task", spec)
+                        continue
+                    except Exception:
+                        pass  # can't reroute: fall through to the error
+                err: BaseException = exceptions.RayActorError(
+                    actor_id=spec.actor_id.hex(), msg="actor died")
                 objs = self._store_errors([r.binary() for r in
                                            spec.return_ids()], err)
                 self._report_task_done(tid, "crashed", objs,
+                                       error=str(err))
+            elif isinstance(spec, TaskSpec):
+                err = exceptions.WorkerCrashedError(
+                    f"worker running {getattr(spec, 'name', '')} died "
+                    f"(exit code {w.proc.poll()})")
+                self._report_task_done(tid, "crashed", [],
                                        error=str(err))
         if actor_id is not None:
             with self._lock:
@@ -441,11 +540,29 @@ class NodeManager:
             err: BaseException = exceptions.RayActorError(msg=p["error"])
         elif p["error"] == "cancelled":
             err = exceptions.TaskCancelledError()
+        elif "died" in (p["error"] or ""):
+            # System failure (worker/node death after retry exhaustion)
+            # surfaces as WorkerCrashedError, matching the client-side
+            # _error_from_reason mapping.
+            err = exceptions.WorkerCrashedError(p["error"])
         else:
             err = exceptions.RayTaskError(p.get("name", ""), p["error"])
         self._store_errors(p["object_ids"], err)
 
     def _on_lease_task(self, spec: TaskSpec):
+        from ray_tpu._private import runtime_env as renv_mod
+
+        if renv_mod.needs_isolation(spec.runtime_env):
+            # working_dir / py_modules need a dedicated worker whose cwd
+            # and sys.path are set at spawn (reference: per-runtime-env
+            # worker pools, worker_pool.h runtime_env_hash keying).
+            # Materialization fetches packages over the GCS conn, so it
+            # must run OFF this handler thread (which IS that conn's
+            # serve loop — a request from here would deadlock).
+            threading.Thread(
+                target=self._lease_task_with_runtime_env, args=(spec,),
+                daemon=True, name="rtpu-nm-renv").start()
+            return
         needs_tpu = spec.resources.get(TPU, 0) > 0
         with self._lock:
             if needs_tpu:
@@ -476,6 +593,45 @@ class NodeManager:
                 w.current_tasks[spec.task_id.binary()] = spec
             return
         self._push_task(w, spec)
+
+    def _materialize_runtime_env(self, runtime_env):
+        """Fetch + extract this env's packages from the GCS KV into the
+        session's URI cache; returns (cwd, extra_pythonpath). Reference:
+        runtime_env plugins' create() hook (plugin.py:24)."""
+        from ray_tpu._private import runtime_env as renv_mod
+
+        base = os.path.join(self.session_dir, "runtime_resources")
+        os.makedirs(base, exist_ok=True)
+
+        def kv_get(key):
+            return self.gcs.request("kv_get", {
+                "ns": renv_mod.KV_NAMESPACE, "key": key}, timeout=60)
+
+        workdir, paths = renv_mod.ensure_runtime_env(kv_get, runtime_env,
+                                                     base)
+        # working_dir is importable too (driver scripts import siblings).
+        if workdir is not None:
+            paths = [workdir] + paths
+        return workdir, paths
+
+    def _lease_task_with_runtime_env(self, spec: TaskSpec):
+        try:
+            cwd, pypaths = self._materialize_runtime_env(spec.runtime_env)
+        except Exception as e:
+            err = exceptions.RayTaskError(
+                getattr(spec, "name", ""),
+                f"runtime_env setup failed: {e}")
+            objs = self._store_errors(
+                [r.binary() for r in spec.return_ids()], err)
+            self._report_task_done(spec.task_id.binary(), "error",
+                                   objs, error=str(e))
+            return
+        env = dict((spec.runtime_env or {}).get("env_vars", {}))
+        w = self._spawn_worker(dedicated=True, env_extra=env, cwd=cwd,
+                               extra_pythonpath=pypaths)
+        with self._lock:
+            w.pending_pushes.append(("run_task", spec))
+            w.current_tasks[spec.task_id.binary()] = spec
 
     def _pop_idle_locked(self) -> Optional[WorkerHandle]:
         while self._idle:
@@ -508,8 +664,29 @@ class NodeManager:
                 spec = self._task_queue.pop(0)
             self._push_task(w, spec)
 
-    def _on_create_actor(self, spec: ActorCreationSpec):
+    def _on_create_actor(self, spec: ActorCreationSpec,
+                         offthread: bool = False):
+        from ray_tpu._private import runtime_env as renv_mod
+
         env = dict((spec.runtime_env or {}).get("env_vars", {}))
+        cwd, pypaths = None, []
+        if renv_mod.needs_isolation(spec.runtime_env):
+            if not offthread:
+                # Package fetch uses the GCS conn; this handler runs ON
+                # that conn's serve thread — hop off it first.
+                threading.Thread(
+                    target=self._on_create_actor, args=(spec, True),
+                    daemon=True, name="rtpu-nm-renv").start()
+                return
+            try:
+                cwd, pypaths = self._materialize_runtime_env(
+                    spec.runtime_env)
+            except Exception as e:
+                self.gcs.notify("actor_state", {
+                    "actor_id": spec.actor_id.binary(), "state": "DEAD",
+                    "creation_failed": True,
+                    "error": f"runtime_env setup failed: {e}"})
+                return
         chips: List[int] = []
         k = int(spec.resources.get(TPU, 0))
         if k > 0:
@@ -525,7 +702,9 @@ class NodeManager:
                 for c in free:
                     self._free_tpu_chips.discard(c)
                 chips = free
-        w = self._spawn_worker(dedicated=True, env_extra=env, tpu_chips=chips)
+        w = self._spawn_worker(dedicated=True, env_extra=env,
+                               tpu_chips=chips, cwd=cwd,
+                               extra_pythonpath=pypaths)
         with self._lock:
             w.state = ACTOR
             w.actor_id = spec.actor_id.binary()

@@ -1,0 +1,164 @@
+"""Runtime environments: working_dir + py_modules shipped through the GCS
+KV with content-addressed URI caching.
+
+Reference: ``python/ray/_private/runtime_env/plugin.py:24`` (plugin
+protocol), ``working_dir.py`` / ``py_modules.py`` plugins, and
+``packaging.py`` (zip + content hash + GCS upload with
+``gcs://_ray_pkg_<hash>.zip`` URIs). Here the package store is the GCS KV
+(namespace ``_runtime_env``), the URI scheme is ``kvzip://<sha1>``, and
+nodes extract each URI once into the session's ``runtime_resources``
+directory (the URI cache).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+KV_NAMESPACE = "_runtime_env"
+URI_SCHEME = "kvzip://"
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+_MAX_PACKAGE_BYTES = 256 * 1024 * 1024
+
+
+def _zip_path(path: str) -> bytes:
+    """Deterministic zip of a directory (or single file) — stable entry
+    order + fixed timestamps so equal content hashes equal."""
+    buf = io.BytesIO()
+    path = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(path):
+            entries = [(os.path.basename(path), path)]
+        else:
+            entries = []
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+                for f in sorted(files):
+                    if f.endswith(".pyc"):
+                        continue
+                    full = os.path.join(root, f)
+                    entries.append((os.path.relpath(full, path), full))
+        total = 0
+        for arcname, full in entries:
+            with open(full, "rb") as fh:
+                data = fh.read()
+            total += len(data)
+            if total > _MAX_PACKAGE_BYTES:
+                raise ValueError(
+                    f"runtime_env package {path} exceeds "
+                    f"{_MAX_PACKAGE_BYTES >> 20} MiB")
+            info = zipfile.ZipInfo(arcname, date_time=(1980, 1, 1, 0, 0, 0))
+            info.external_attr = (os.stat(full).st_mode & 0xFFFF) << 16
+            zf.writestr(info, data)
+    return buf.getvalue()
+
+
+def _module_zip(path: str) -> bytes:
+    """Zip a python module so it extracts as an importable top-level name:
+    a package dir ``.../mymod`` lands as ``mymod/...``; a file
+    ``.../util.py`` lands as ``util.py``."""
+    path = os.path.abspath(path)
+    if os.path.isfile(path):
+        return _zip_path(path)
+    buf = io.BytesIO()
+    base = os.path.basename(path.rstrip("/"))
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+            for f in sorted(files):
+                if f.endswith(".pyc"):
+                    continue
+                full = os.path.join(root, f)
+                arc = os.path.join(base, os.path.relpath(full, path))
+                info = zipfile.ZipInfo(arc, date_time=(1980, 1, 1, 0, 0, 0))
+                with open(full, "rb") as fh:
+                    zf.writestr(info, fh.read())
+    return buf.getvalue()
+
+
+def _upload(kv, blob: bytes) -> str:
+    h = hashlib.sha1(blob).hexdigest()
+    key = h.encode()
+    # Content-addressed: identical content uploads once cluster-wide.
+    if not kv.exists(key, namespace=KV_NAMESPACE):
+        kv.put(key, blob, namespace=KV_NAMESPACE)
+    return URI_SCHEME + h
+
+
+def package_runtime_env(kv, runtime_env: Optional[Dict[str, Any]]
+                        ) -> Optional[Dict[str, Any]]:
+    """Driver side: replace local working_dir / py_modules paths with
+    content-addressed KV URIs (reference: packaging.py upload_package_
+    if_needed). Already-URI entries pass through untouched."""
+    if not runtime_env:
+        return runtime_env
+    env = dict(runtime_env)
+    wd = env.get("working_dir")
+    if wd and not wd.startswith(URI_SCHEME):
+        if not os.path.isdir(wd):
+            raise ValueError(f"runtime_env working_dir {wd!r} is not a "
+                             f"directory")
+        env["working_dir"] = _upload(kv, _zip_path(wd))
+    mods = env.get("py_modules")
+    if mods:
+        out: List[str] = []
+        for m in mods:
+            if isinstance(m, str) and m.startswith(URI_SCHEME):
+                out.append(m)
+                continue
+            if not os.path.exists(m):
+                raise ValueError(f"runtime_env py_module {m!r} not found")
+            out.append(_upload(kv, _module_zip(m)))
+        env["py_modules"] = out
+    return env
+
+
+def needs_isolation(runtime_env: Optional[Dict[str, Any]]) -> bool:
+    """True when this env requires a dedicated worker (cwd / sys.path)."""
+    return bool(runtime_env and (runtime_env.get("working_dir")
+                                 or runtime_env.get("py_modules")))
+
+
+def ensure_runtime_env(kv_get, runtime_env: Optional[Dict[str, Any]],
+                       base_dir: str) -> Tuple[Optional[str], List[str]]:
+    """Node side: materialize each URI once under ``base_dir/<hash>/``
+    (the URI cache) and return (working_dir_path, py_module_paths).
+
+    ``kv_get(key: bytes) -> Optional[bytes]`` fetches from the GCS KV
+    namespace ``_runtime_env``.
+    """
+    if not runtime_env:
+        return None, []
+
+    def materialize(uri: str) -> str:
+        h = uri[len(URI_SCHEME):]
+        target = os.path.join(base_dir, h)
+        if os.path.isdir(target):
+            return target  # cache hit
+        blob = kv_get(h.encode())
+        if blob is None:
+            raise RuntimeError(f"runtime_env package {uri} missing from GCS")
+        tmp = target + f".tmp{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, target)  # atomic publish; loser cleans up
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+        return target
+
+    workdir = None
+    wd = runtime_env.get("working_dir")
+    if wd and wd.startswith(URI_SCHEME):
+        workdir = materialize(wd)
+    paths = []
+    for m in runtime_env.get("py_modules") or []:
+        if isinstance(m, str) and m.startswith(URI_SCHEME):
+            paths.append(materialize(m))
+    return workdir, paths

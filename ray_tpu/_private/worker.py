@@ -260,6 +260,7 @@ class CoreWorker:
         store_path: Optional[str] = None,
         job_id: Optional[JobID] = None,
         client_id: Optional[str] = None,
+        log_to_driver: bool = False,
     ):
         self.role = role
         self.client_id = client_id or uuid.uuid4().hex
@@ -267,10 +268,12 @@ class CoreWorker:
         self.gcs = _GcsChannel(gcs_address, self._on_gcs_msg,
                                name=f"{role}-gcs")
         self.gcs_address = gcs_address
+        self._log_to_driver = log_to_driver and role == "driver"
         reply = self.gcs.request("register_client", {
             "client_id": self.client_id,
             "role": role,
             "job_id": job_id,
+            "log_to_driver": self._log_to_driver,
         })
         self.job_id: JobID = reply["job_id"] if role == "driver" else job_id
         # Survive a GCS restart: later calls re-register with the same
@@ -279,6 +282,7 @@ class CoreWorker:
             "client_id": self.client_id, "role": role,
             "job_id": self.job_id,
             "existing_job": self.job_id if role == "driver" else None,
+            "log_to_driver": self._log_to_driver,
         })
         self.node_id = node_id or reply["head_node_id"]
         store_path = store_path or reply["head_store_path"]
@@ -327,7 +331,22 @@ class CoreWorker:
     # ----------------------------------------------------------- plumbing
 
     def _on_gcs_msg(self, conn, mtype, payload, msg_id):
-        pass  # drivers/workers currently receive only replies
+        if mtype == "driver_logs" and self._log_to_driver:
+            # Re-print remote worker output locally (reference:
+            # worker.print_logs / print_to_stdstream, _private/worker.py),
+            # prefixed with the producing worker's identity.
+            import sys as _sys
+
+            node12 = (payload.get("node_id") or "")[:12]
+            for e in payload.get("entries", []):
+                stream = _sys.stderr if e.get("stream") == "stderr"                     else _sys.stdout
+                prefix = f"({e.get('worker_id', '?')} pid={e.get('pid')}"                          f" node={node12})"
+                for line in e.get("lines", []):
+                    print(f"{prefix} {line}", file=stream)
+            try:
+                stream.flush()
+            except Exception:
+                pass
 
     def _own_nm_address(self) -> Optional[str]:
         if self._nm_address_cache is None:
@@ -668,6 +687,10 @@ class CoreWorker:
                     placement_group=None,
                     placement_group_bundle_index: int = -1,
                     runtime_env=None) -> List[ObjectRef]:
+        if runtime_env:
+            from ray_tpu._private import runtime_env as renv_mod
+
+            runtime_env = renv_mod.package_runtime_env(self.kv(), runtime_env)
         args_blob, deps = self._serialize_args(args, kwargs)
         task_id = TaskID.for_task(self.job_id)
         spec = TaskSpec(
@@ -713,6 +736,10 @@ class CoreWorker:
                      placement_group=None,
                      placement_group_bundle_index: int = -1,
                      runtime_env=None) -> ActorID:
+        if runtime_env:
+            from ray_tpu._private import runtime_env as renv_mod
+
+            runtime_env = renv_mod.package_runtime_env(self.kv(), runtime_env)
         args_blob, deps = self._serialize_args(args, kwargs)
         actor_id = ActorID.of(self.job_id)
         spec = ActorCreationSpec(
@@ -1064,7 +1091,8 @@ def init(address=None, num_cpus=None, num_tpus=None, resources=None,
                     raise ConnectionError(
                         "address='auto' but RAY_TPU_ADDRESS is not set")
             gcs_address = address
-        worker = CoreWorker(gcs_address, role="driver")
+        worker = CoreWorker(gcs_address, role="driver",
+                            log_to_driver=log_to_driver)
         if namespace:
             worker.namespace = namespace
         _global_worker = worker

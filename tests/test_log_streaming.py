@@ -1,0 +1,81 @@
+"""Log streaming to driver (reference: _private/log_monitor.py:104 —
+worker stdout/stderr tailed from session files and republished on the
+driver with a worker-identity prefix)."""
+
+import os
+import sys
+import time
+
+import ray_tpu
+
+
+def _drain_until(capfd, markers, timeout=15.0):
+    """Accumulate captured driver output until every marker appeared."""
+    if isinstance(markers, str):
+        markers = [markers]
+    buf_out, buf_err = "", ""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out, err = capfd.readouterr()
+        buf_out += out
+        buf_err += err
+        if all(m in buf_out or m in buf_err for m in markers):
+            return buf_out, buf_err
+        time.sleep(0.2)
+    raise AssertionError(
+        f"markers {markers!r} never reached the driver; "
+        f"stdout={buf_out[-500:]!r} stderr={buf_err[-500:]!r}")
+
+
+def test_print_in_task_reaches_driver(capfd):
+    ray_tpu.init(num_cpus=2, log_to_driver=True)
+    try:
+        @ray_tpu.remote
+        def chatty():
+            print("stream-me-MARKER-out")
+            print("stream-me-MARKER-err", file=sys.stderr)
+            return os.getpid()
+
+        pid = ray_tpu.get(chatty.remote(), timeout=60)
+        out, err = _drain_until(
+            capfd, ["stream-me-MARKER-out", "stream-me-MARKER-err"])
+        line = next(ln for ln in out.splitlines()
+                    if "stream-me-MARKER-out" in ln)
+        # Prefixed with the producing worker's identity.
+        assert f"pid={pid}" in line and line.startswith("(")
+        # stderr lines land on the driver's stderr.
+        assert "stream-me-MARKER-err" in err
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_log_to_driver_false_stays_quiet(capfd):
+    ray_tpu.init(num_cpus=2, log_to_driver=False)
+    try:
+        @ray_tpu.remote
+        def chatty():
+            print("should-not-appear-MARKER")
+            return 1
+
+        assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+        time.sleep(1.5)
+        out, err = capfd.readouterr()
+        assert "should-not-appear-MARKER" not in out + err
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_prints_reach_driver(capfd):
+    ray_tpu.init(num_cpus=2, log_to_driver=True)
+    try:
+        @ray_tpu.remote
+        class Talker:
+            def say(self, msg):
+                print(f"actor-says-{msg}")
+                return True
+
+        t = Talker.remote()
+        assert ray_tpu.get(t.say.remote("MARKER42"), timeout=60)
+        _drain_until(capfd, "actor-says-MARKER42")
+    finally:
+        ray_tpu.shutdown()
