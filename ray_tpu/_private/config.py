@@ -137,6 +137,17 @@ _d("max_lineage_reconstructions", 3,
    "Times a lost object may be rebuilt by re-running its producing task "
    "(reference: object_recovery_manager.h:41 + task_manager resubmit).")
 
+# --- memory monitor ---------------------------------------------------------
+_d("memory_monitor_refresh_ms", 250,
+   "Node memory sampling period; 0 disables the monitor "
+   "(reference: memory_monitor.h:52 kMonitorIntervalMs).")
+_d("memory_usage_threshold", 0.95,
+   "Fraction of the node memory limit above which the worker-killing "
+   "policy engages (reference: ray_config_def.h memory_usage_threshold).")
+_d("memory_limit_bytes", 0,
+   "Absolute node memory budget for workers+store; 0 derives it from "
+   "system MemTotal. Tests set a small value to trigger OOM kills.")
+
 # --- gcs --------------------------------------------------------------------
 _d("gcs_storage", "memory", "GCS table storage backend: memory | file.")
 _d("gcs_file_storage_path", "", "Path for the file storage backend.")

@@ -66,6 +66,8 @@ class WorkerHandle:
     log_paths: Dict[str, str] = field(default_factory=dict)   # stream -> path
     log_offsets: Dict[str, int] = field(default_factory=dict)
     logs_done: bool = False        # dead + fully drained
+    busy_since: float = 0.0        # when the current task started
+    death_reason: str = ""         # e.g. set by the memory monitor
 
 
 class NodeManager:
@@ -169,6 +171,12 @@ class NodeManager:
                                              daemon=True,
                                              name="rtpu-nm-logmon")
         self._log_monitor.start()
+        self.oom_kills = 0
+        if config.memory_monitor_refresh_ms > 0:
+            self._mem_monitor = threading.Thread(
+                target=self._memory_monitor_loop, daemon=True,
+                name="rtpu-nm-memmon")
+            self._mem_monitor.start()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -256,6 +264,98 @@ class NodeManager:
                         "node_id": self.node_id, "entries": entries})
                 except Exception:
                     pass
+
+    # -------------------------------------------------------- memory monitor
+
+    @staticmethod
+    def _proc_rss(pid: int) -> int:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+        except (OSError, ValueError, IndexError):
+            return 0
+
+    def _memory_budget(self) -> int:
+        limit = int(config.memory_limit_bytes)
+        if limit > 0:
+            return limit
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        return int(line.split()[1]) * 1024
+        except OSError:
+            pass
+        return 0
+
+    def _memory_monitor_loop(self):
+        """Sample worker RSS + store usage; over the threshold, kill the
+        newest retriable task's worker (reference: memory_monitor.h:52 +
+        worker_killing_policy.h:34 RetriableFIFO policy). Killed tasks go
+        through the normal crash path, so retry budgets apply and the
+        OOM cause reaches the caller's error."""
+        period = max(0.05, config.memory_monitor_refresh_ms / 1000.0)
+        while not self._shutdown:
+            time.sleep(period)
+            budget = self._memory_budget()
+            if budget <= 0:
+                continue
+            threshold = budget * float(config.memory_usage_threshold)
+            with self._lock:
+                workers = [w for w in self._workers.values()
+                           if w.proc.poll() is None]
+            usage = sum(self._proc_rss(w.proc.pid) for w in workers)
+            try:
+                usage += self.store.stats().get("used_bytes", 0)
+            except Exception:
+                pass
+            if usage <= threshold:
+                continue
+            victim = self._pick_oom_victim(workers)
+            if victim is None:
+                continue
+            rss = self._proc_rss(victim.proc.pid)
+            reason = (
+                f"killed by the memory monitor (OOM): node usage "
+                f"{usage >> 20} MiB over threshold "
+                f"{int(threshold) >> 20} MiB; worker rss {rss >> 20} MiB")
+            logger.warning("%s (pid %d)", reason, victim.proc.pid)
+            with self._lock:
+                victim.death_reason = reason
+            self.oom_kills += 1
+            try:
+                self.gcs.notify("task_events", [{
+                    "task_id": tid.hex() if hasattr(tid, "hex") else
+                    tid.hex(),
+                    "name": getattr(spec, "name",
+                                    getattr(spec, "method_name", "")),
+                    "kind": "task", "node_id": self.node_id,
+                    "worker_id": victim.worker_id.hex(),
+                    "pid": victim.proc.pid, "start": victim.busy_since,
+                    "end": time.time(), "status": "oom_killed",
+                } for tid, spec in victim.current_tasks.items()])
+            except Exception:
+                pass
+            try:
+                victim.proc.kill()
+            except Exception:
+                pass
+
+    def _pick_oom_victim(self, workers) -> Optional[WorkerHandle]:
+        """RetriableFIFO: newest retriable plain-task worker first, then
+        newest non-retriable plain-task worker; actors are spared (their
+        restart blast radius is larger — reference
+        worker_killing_policy.h:34 prefers retriable tasks too)."""
+        def newest(cands):
+            return max(cands, key=lambda w: w.busy_since, default=None)
+
+        task_workers = [w for w in workers
+                        if w.actor_id is None and w.current_tasks]
+        retriable = [w for w in task_workers
+                     if any(getattr(s, "retries_left",
+                                    getattr(s, "max_retries", 0))
+                            for s in w.current_tasks.values())]
+        return newest(retriable) or newest(task_workers)
 
     def _heartbeat_loop(self):
         """Periodic liveness report (reference: raylet heartbeats feeding
@@ -448,9 +548,10 @@ class NodeManager:
                 self._report_task_done(tid, "crashed", objs,
                                        error=str(err))
             elif isinstance(spec, TaskSpec):
+                detail = w.death_reason or f"exit code {w.proc.poll()}"
                 err = exceptions.WorkerCrashedError(
                     f"worker running {getattr(spec, 'name', '')} died "
-                    f"(exit code {w.proc.poll()})")
+                    f"({detail})")
                 self._report_task_done(tid, "crashed", [],
                                        error=str(err))
         if actor_id is not None:
@@ -643,6 +744,7 @@ class NodeManager:
     def _push_task(self, w: WorkerHandle, spec: TaskSpec):
         with self._lock:
             w.state = BUSY
+            w.busy_since = time.time()
             w.current_tasks[spec.task_id.binary()] = spec
             if w.conn is None:
                 w.pending_pushes.append(("run_task", spec))

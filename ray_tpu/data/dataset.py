@@ -152,6 +152,50 @@ class Dataset:
         ds._cached = ds._input_blocks
         return ds
 
+    def iter_block_results(self, prefetch_blocks: int = 2
+                           ) -> Iterator[List[Any]]:
+        """Streaming executor: yield each block's transformed rows in
+        block order while keeping at most ``prefetch_blocks`` block tasks
+        in flight ahead of the consumer — execution overlaps consumption
+        with bounded memory (reference:
+        _internal/execution/streaming_executor.py:35 + backpressure via
+        resource_manager; the bound here is the in-flight block count).
+        Already-materialized datasets stream from the cache."""
+        import collections as _collections
+
+        prefetch = max(1, int(prefetch_blocks))
+        if self._cached is not None or not self._stages:
+            for ref in (self._cached if self._cached is not None
+                        else self._input_blocks):
+                yield ray_tpu.get(ref)
+            return
+        stages = self._stages
+
+        @ray_tpu.remote
+        def _run_block(rows):
+            return _apply_stages(rows, stages)
+
+        blocks = iter(self._input_blocks)
+        in_flight: _collections.deque = _collections.deque()
+        for b in itertools.islice(blocks, prefetch + 1):
+            in_flight.append(_run_block.remote(b))
+        while in_flight:
+            ref = in_flight.popleft()
+            nxt = next(blocks, None)
+            if nxt is not None:
+                in_flight.append(_run_block.remote(nxt))
+            yield ray_tpu.get(ref)
+
+    def streaming_split(self, n: int) -> List["Dataset"]:
+        """Split by round-robin over INPUT blocks without executing
+        anything: each shard keeps the stage chain lazy, so data-parallel
+        consumers stream their own blocks (reference:
+        dataset.streaming_split). Use split() for row-exact splitting."""
+        shards = []
+        for i in builtins.range(n):
+            shards.append(Dataset(self._input_blocks[i::n], self._stages))
+        return shards
+
     def _all_rows(self) -> List[Any]:
         out: List[Any] = []
         for rows in ray_tpu.get(self._execute()):
@@ -309,15 +353,19 @@ class Dataset:
         return len(self._input_blocks)
 
     def iter_rows(self) -> Iterator[Any]:
-        for ref in self._execute():
-            yield from ray_tpu.get(ref)
+        for rows in self.iter_block_results():
+            yield from rows
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Any]:
+                     drop_last: bool = False,
+                     prefetch_blocks: int = 2) -> Iterator[Any]:
+        """Stream batches: blocks execute ahead of the consumer through
+        the streaming executor (bounded in-flight), so training overlaps
+        with ingest instead of waiting for the whole dataset."""
         buf: List[Any] = []
-        for ref in self._execute():
-            buf.extend(ray_tpu.get(ref))
+        for rows in self.iter_block_results(prefetch_blocks=prefetch_blocks):
+            buf.extend(rows)
             while len(buf) >= batch_size:
                 yield _rows_to_batch(buf[:batch_size], batch_format)
                 buf = buf[batch_size:]

@@ -19,7 +19,8 @@ from ray_tpu.train.config import (  # noqa: F401
 from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
 from ray_tpu.train import session  # noqa: F401
 from ray_tpu.train.session import (  # noqa: F401
-    report, get_checkpoint, get_world_rank, get_world_size, get_local_rank,
+    report, get_checkpoint, get_dataset_shard, get_world_rank,
+    get_world_size, get_local_rank,
     get_context,
 )
 from ray_tpu.train.data_parallel import DataParallelTrainer, JaxTrainer  # noqa: F401
@@ -27,6 +28,7 @@ from ray_tpu.train.data_parallel import DataParallelTrainer, JaxTrainer  # noqa:
 __all__ = [
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "Result", "Checkpoint", "session", "report", "get_checkpoint",
+    "get_dataset_shard",
     "get_world_rank", "get_world_size", "get_local_rank", "get_context",
     "DataParallelTrainer", "JaxTrainer",
 ]

@@ -38,9 +38,11 @@ class DataParallelTrainer:
         run_config: Optional[RunConfig] = None,
         backend: Optional[str] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self._train_loop = train_loop_per_worker
         self._config = train_loop_config
+        self._datasets = datasets
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self._backend = backend or self._default_backend
@@ -70,7 +72,8 @@ class DataParallelTrainer:
                 group_name=f"train_{name}_{uuid.uuid4().hex[:6]}",
                 experiment_name=name)
             try:
-                group.start(self._train_loop, self._config, latest_ckpt)
+                group.start(self._train_loop, self._config, latest_ckpt,
+                            datasets=self._datasets)
                 latest_ckpt, ckpt_index, error = self._drive(
                     group, run_dir, history, latest_ckpt, ckpt_index)
             except BaseException as e:

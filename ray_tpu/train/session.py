@@ -18,13 +18,15 @@ _session: Optional["_TrainSession"] = None
 class _TrainSession:
     def __init__(self, world_rank: int, world_size: int, local_rank: int,
                  checkpoint: Optional[Checkpoint], experiment_name: str = "",
-                 collective_group_name: str = ""):
+                 collective_group_name: str = "",
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
         self.experiment_name = experiment_name
         self.collective_group_name = collective_group_name
         self._start_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
         self.reports: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
@@ -76,6 +78,20 @@ def report(metrics: Dict[str, Any],
 def get_checkpoint() -> Optional[Checkpoint]:
     """The checkpoint to resume from, if the run was restored."""
     return _get_session()._start_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a Dataset passed to the trainer via
+    ``datasets={name: ds}`` (reference: ``air/session.py``
+    get_dataset_shard + DataParallelTrainer dataset splitting). The shard
+    is lazy; iterate it with ``iter_batches`` to stream blocks while
+    training (streaming ingest)."""
+    shards = _get_session().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard named {name!r}; trainer datasets: "
+            f"{sorted(shards)}")
+    return shards[name]
 
 
 def get_world_rank() -> int:

@@ -56,7 +56,8 @@ class TrainWorker:
         return True
 
     def start(self, fn_blob: bytes, config: Optional[dict],
-              checkpoint_path: Optional[str]) -> bool:
+              checkpoint_path: Optional[str],
+              dataset_shards: Optional[Dict[str, Any]] = None) -> bool:
         fn: Callable = cloudpickle.loads(fn_blob)
         ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
         sess = session_mod._init_session(
@@ -64,7 +65,8 @@ class TrainWorker:
             local_rank=self.local_rank, checkpoint=ckpt,
             experiment_name=self.experiment_name,
             collective_group_name=self.group_name if self.world_size > 1
-            else "")
+            else "",
+            dataset_shards=dataset_shards)
 
         def run():
             try:
@@ -146,11 +148,20 @@ class WorkerGroup:
         ray_tpu.get([w.setup_collective.remote() for w in self.workers])
 
     def start(self, train_fn: Callable, config: Optional[dict],
-              checkpoint: Optional[Checkpoint]):
+              checkpoint: Optional[Checkpoint],
+              datasets: Optional[Dict[str, Any]] = None):
         blob = cloudpickle.dumps(train_fn)
         path = checkpoint.path if checkpoint is not None else None
-        ray_tpu.get([w.start.remote(blob, config, path)
-                     for w in self.workers])
+        # Shard each dataset lazily by blocks: every rank executes only
+        # its own blocks, streaming them during training (train ingest).
+        per_rank: List[Optional[Dict[str, Any]]] = [None] * self.num_workers
+        if datasets:
+            split = {name: ds.streaming_split(self.num_workers)
+                     for name, ds in datasets.items()}
+            per_rank = [{name: shards[r] for name, shards in split.items()}
+                        for r in range(self.num_workers)]
+        ray_tpu.get([w.start.remote(blob, config, path, per_rank[i])
+                     for i, w in enumerate(self.workers)])
 
     def poll(self) -> List[Dict[str, Any]]:
         return ray_tpu.get([w.poll.remote() for w in self.workers])
