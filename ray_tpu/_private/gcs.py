@@ -95,6 +95,80 @@ class _ObjWaiter:
     deadline: Optional[float] = None
 
 
+class _ShapeQueues:
+    """Ready queue indexed by scheduling shape (reference:
+    ``raylet/scheduling/cluster_task_manager.h:42`` — tasks grouped by
+    SchedulingClass so one infeasibility verdict skips the whole class).
+
+    Scheduling cost per event is O(shapes x nodes + dispatched), not
+    O(queue): a bucket whose head can't place is skipped in one check,
+    even with a million tasks queued behind it. FIFO order holds within
+    a shape (the reference makes the same trade).
+    """
+
+    def __init__(self):
+        self._buckets: Dict[Any, collections.deque] = \
+            collections.OrderedDict()
+        self._count = 0
+
+    @staticmethod
+    def shape_key(spec) -> Any:
+        if isinstance(spec, _ActorCreationShim):
+            # Each pending actor is its own bucket: one unplaceable actor
+            # must not shadow differently-shaped ones.
+            return ("actor", spec.actor_id.binary())
+        res = getattr(spec, "resources", None) or {}
+        strat = getattr(spec, "scheduling_strategy", None)
+        pg = getattr(spec, "placement_group_id", None)
+        return ("task", tuple(sorted(res.items())), repr(strat),
+                pg.binary() if pg is not None else None,
+                getattr(spec, "placement_group_bundle_index", -1))
+
+    def append(self, spec) -> None:
+        self._buckets.setdefault(
+            self.shape_key(spec), collections.deque()).append(spec)
+        self._count += 1
+
+    def appendleft(self, spec) -> None:
+        self._buckets.setdefault(
+            self.shape_key(spec), collections.deque()).appendleft(spec)
+        self._count += 1
+
+    def extend(self, specs) -> None:
+        for s in specs:
+            self.append(s)
+
+    def buckets(self):
+        return list(self._buckets.items())
+
+    def pop_head(self, key):
+        q = self._buckets.get(key)
+        if not q:
+            return None
+        self._count -= 1
+        spec = q.popleft()
+        if not q:
+            self._buckets.pop(key, None)
+        return spec
+
+    def remove_task(self, tid: bytes) -> None:
+        for key, q in list(self._buckets.items()):
+            kept = collections.deque(
+                s for s in q if s.task_id.binary() != tid)
+            self._count -= len(q) - len(kept)
+            if kept:
+                self._buckets[key] = kept
+            else:
+                self._buckets.pop(key, None)
+
+    def __iter__(self):
+        for q in self._buckets.values():
+            yield from q
+
+    def __len__(self) -> int:
+        return self._count
+
+
 class GcsServer:
     """The head control-plane service."""
 
@@ -129,7 +203,7 @@ class GcsServer:
         self._kv: Dict[str, Dict[bytes, bytes]] = collections.defaultdict(dict)
 
         # task scheduling
-        self._queued_tasks: collections.deque = collections.deque()
+        self._queued_tasks = _ShapeQueues()
         self._waiting_tasks: Dict[bytes, List[TaskSpec]] = collections.defaultdict(list)
         self._running_tasks: Dict[bytes, Tuple[TaskSpec, str]] = {}  # task_id -> (spec, node)
         self._cancelled_tasks: Set[bytes] = set()
@@ -685,38 +759,53 @@ class GcsServer:
         return None
 
     def _try_schedule(self):
-        """Drain the ready queue onto nodes with capacity."""
+        """Drain the ready queue onto nodes with capacity.
+
+        The queue is indexed by scheduling shape: when a bucket's head
+        can't place (no feasible node), the WHOLE bucket is skipped in
+        that one check — cost per event is O(shapes x nodes +
+        dispatched), independent of how many tasks are queued (reference:
+        cluster_task_manager.h:42 scheduling classes).
+        """
         if not self._nodes:
             return
-        deferred = []
-        while self._queued_tasks:
-            spec = self._queued_tasks.popleft()
-            if isinstance(spec, _ActorCreationShim):
-                entry = self._actors.get(spec.actor_id.binary())
-                if entry is not None and entry.node_id is None and \
-                        entry.state in (PENDING_CREATION, DEPENDENCIES_UNREADY,
-                                        RESTARTING):
-                    if not self._schedule_actor(entry):
-                        deferred.append(spec)
-                continue
-            if spec.task_id.binary() in self._cancelled_tasks:
-                continue
-            if spec.placement_group_id is not None:
-                node = self._node_for_pg_task(spec)
-            else:
-                node = self._pick_node(spec.resources, spec.scheduling_strategy,
-                                       preferred=spec.owner_node)
-            if node is None or not self._acquire_for(spec, node):
-                deferred.append(spec)
-                continue
-            self._running_tasks[spec.task_id.binary()] = (spec, node.node_id)
-            try:
-                node.conn.notify("lease_task", spec)
-            except Exception:
-                self._running_tasks.pop(spec.task_id.binary(), None)
-                self._release_for(spec, node.node_id)
-                deferred.append(spec)
-        self._queued_tasks.extend(deferred)
+        for key, _q in self._queued_tasks.buckets():
+            while True:
+                spec = self._queued_tasks.pop_head(key)
+                if spec is None:
+                    break
+                if isinstance(spec, _ActorCreationShim):
+                    entry = self._actors.get(spec.actor_id.binary())
+                    if entry is not None and entry.node_id is None and \
+                            entry.state in (PENDING_CREATION,
+                                            DEPENDENCIES_UNREADY,
+                                            RESTARTING):
+                        if not self._schedule_actor(entry):
+                            self._queued_tasks.appendleft(spec)
+                            break  # this actor can't place now
+                    continue
+                if spec.task_id.binary() in self._cancelled_tasks:
+                    continue
+                if spec.placement_group_id is not None:
+                    node = self._node_for_pg_task(spec)
+                else:
+                    node = self._pick_node(spec.resources,
+                                           spec.scheduling_strategy,
+                                           preferred=spec.owner_node)
+                if node is None or not self._acquire_for(spec, node):
+                    # Head of this shape can't place -> nothing behind it
+                    # in the same shape can either; skip the bucket.
+                    self._queued_tasks.appendleft(spec)
+                    break
+                self._running_tasks[spec.task_id.binary()] = (spec,
+                                                              node.node_id)
+                try:
+                    node.conn.notify("lease_task", spec)
+                except Exception:
+                    self._running_tasks.pop(spec.task_id.binary(), None)
+                    self._release_for(spec, node.node_id)
+                    self._queued_tasks.appendleft(spec)
+                    break
 
     def _h_task_done(self, conn, p, msg_id):
         """Node manager reports task completion (success or failure)."""
@@ -768,8 +857,7 @@ class GcsServer:
         with self._lock:
             self._cancelled_tasks.add(tid)
             # remove from queues
-            self._queued_tasks = collections.deque(
-                s for s in self._queued_tasks if s.task_id.binary() != tid)
+            self._queued_tasks.remove_task(tid)
             for lst in self._waiting_tasks.values():
                 lst[:] = [s for s in lst if s.task_id.binary() != tid]
             running = self._running_tasks.get(tid)

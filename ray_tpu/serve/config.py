@@ -18,6 +18,11 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
+    # Scale decisions use the queue depth AVERAGED over this look-back
+    # window, not the instantaneous snapshot (reference:
+    # autoscaling_policy.py:54-70 look_back_period_s) — one bursty probe
+    # can neither trigger an upscale nor a downscale on its own.
+    look_back_period_s: float = 3.0
 
 
 @dataclasses.dataclass

@@ -35,6 +35,8 @@ class _DeploymentState:
         self.target = config["num_replicas"]
         self.last_scale_ts = 0.0
         self.deleting = False
+        # (ts, total_ongoing) samples for the autoscaler's look-back window.
+        self.ongoing_history: List[tuple] = []
 
 
 class ServeController:
@@ -166,8 +168,11 @@ class ServeController:
 
     def _autoscale_one(self, st: _DeploymentState,
                        stats_by_replica: Dict[int, dict], now: float):
-        """Queue-depth policy (reference: autoscaling_policy.py:70):
-        desired = ceil(total_ongoing / target_ongoing_requests)."""
+        """Queue-depth policy with look-back smoothing (reference:
+        autoscaling_policy.py:54-70): desired =
+        ceil(avg_ongoing_over_window / target_ongoing_requests), where the
+        average spans look_back_period_s of samples — instantaneous spikes
+        or dips can't flap the replica count."""
         import math
 
         ac = st.config.get("autoscaling_config")
@@ -179,7 +184,14 @@ class ServeController:
                  if id(r) in stats_by_replica]
         if not stats:
             return
-        ongoing = sum(s["ongoing"] for s in stats)
+        sample = sum(s["ongoing"] for s in stats)
+        window = float(ac.get("look_back_period_s") or 0.0)
+        with self._lock:
+            st.ongoing_history.append((now, sample))
+            st.ongoing_history = [(t, v) for t, v in st.ongoing_history
+                                  if now - t <= max(window, 0.0)]
+            vals = [v for _, v in st.ongoing_history]
+        ongoing = sum(vals) / len(vals) if vals else sample
         desired = math.ceil(ongoing / ac["target_ongoing_requests"]) \
             if ongoing else ac["min_replicas"]
         desired = min(max(desired, ac["min_replicas"]), ac["max_replicas"])
