@@ -75,7 +75,12 @@ class GPTConfig:
     pp_microbatches: Optional[int] = None
     # Pallas flash-attention kernel (ops/flash_attention.py) for the
     # single-device attention path; ignored when ring attention engages.
-    flash_attention: bool = False
+    # True/False force it; "auto" (recommended) uses XLA's fused attention
+    # up to flash_min_seq (where XLA's kernel is faster on v5e and remat
+    # bounds the O(L^2) memory) and the Pallas kernel beyond it (where
+    # O(L) memory is the difference between running and OOM).
+    flash_attention: Any = False
+    flash_min_seq: int = 4096
 
     @property
     def head_dim(self) -> int:
@@ -245,7 +250,10 @@ def _attention(q, k, v, cfg: GPTConfig, mesh: Optional[Mesh],
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         return fn(q, k, v)
-    if cfg.flash_attention:
+    use_flash = cfg.flash_attention
+    if use_flash == "auto":
+        use_flash = q.shape[1] >= cfg.flash_min_seq
+    if use_flash:
         from ray_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=True)

@@ -12,53 +12,53 @@ class ActorPool:
 
     def __init__(self, actors: List[Any]):
         self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
+        self._actor_by_ref = {}
+        self._ref_by_submit_seq = {}
+        self._submit_seq = 0
+        self._drain_seq = 0
 
     def submit(self, fn: Callable, value: Any) -> None:
         if not self._idle:
             raise ValueError("no idle actors; call get_next first")
         actor = self._idle.pop(0)
         ref = fn(actor, value)
-        self._future_to_actor[ref] = actor
-        self._index_to_future[self._next_task_index] = ref
-        self._next_task_index += 1
+        self._actor_by_ref[ref] = actor
+        self._ref_by_submit_seq[self._submit_seq] = ref
+        self._submit_seq += 1
 
     def has_next(self) -> bool:
-        return self._next_return_index < self._next_task_index
+        return self._drain_seq < self._submit_seq
 
     def get_next(self, timeout=None) -> Any:
         if not self.has_next():
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
+        ref = self._ref_by_submit_seq.pop(self._drain_seq)
+        self._drain_seq += 1
         core = worker_mod.require_worker()
         value = core.get([ref], timeout=timeout)[0]
-        self._idle.append(self._future_to_actor.pop(ref))
+        self._idle.append(self._actor_by_ref.pop(ref))
         return value
 
     def get_next_unordered(self, timeout=None) -> Any:
         if not self.has_next():
             raise StopIteration("no pending results")
         core = worker_mod.require_worker()
-        refs = list(self._future_to_actor.keys())
+        refs = list(self._actor_by_ref.keys())
         ready, _ = core.wait(refs, num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
         ref = ready[0]
-        for idx, fut in list(self._index_to_future.items()):
+        for idx, fut in list(self._ref_by_submit_seq.items()):
             if fut == ref:
-                del self._index_to_future[idx]
-                if idx == self._next_return_index:
-                    while self._next_return_index not in \
-                            self._index_to_future and \
-                            self._next_return_index < self._next_task_index:
-                        self._next_return_index += 1
+                del self._ref_by_submit_seq[idx]
+                if idx == self._drain_seq:
+                    while self._drain_seq not in \
+                            self._ref_by_submit_seq and \
+                            self._drain_seq < self._submit_seq:
+                        self._drain_seq += 1
                 break
         value = core.get([ref])[0]
-        self._idle.append(self._future_to_actor.pop(ref))
+        self._idle.append(self._actor_by_ref.pop(ref))
         return value
 
     def map(self, fn: Callable, values: Iterable[Any]):

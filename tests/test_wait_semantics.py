@@ -1,0 +1,114 @@
+"""Pin ray.wait() semantics (reference: core_worker Wait + the public
+contract in python/ray/_private/worker.py wait docstring):
+
+- ready contains at most num_returns refs, in the order of the input;
+- a FAILED object counts as ready (so a follow-up get raises promptly
+  instead of hanging);
+- timeout=0 is a non-blocking poll;
+- fetch_local=False only answers availability, it does not pull.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def ray_4cpu():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_wait_preserves_input_order(ray_4cpu):
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    refs = [quick.remote(i) for i in range(6)]
+    ray_tpu.get(list(refs))  # all complete
+    ready, not_ready = ray_tpu.wait(refs, num_returns=3, timeout=5)
+    assert ready == refs[:3]
+    assert not_ready == refs[3:]
+
+
+def test_failed_object_counts_as_ready(ray_4cpu):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("expected")
+
+    @ray_tpu.remote
+    def hang():
+        time.sleep(30)
+
+    bad, slow = boom.remote(), hang.remote()
+    ready, not_ready = ray_tpu.wait([bad, slow], num_returns=1, timeout=10)
+    assert ready == [bad]
+    assert not_ready == [slow]
+    with pytest.raises(ValueError, match="expected"):
+        ray_tpu.get(bad)
+
+
+def test_wait_timeout_zero_is_poll(ray_4cpu):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(30)
+
+    ref = hang.remote()
+    t0 = time.time()
+    ready, not_ready = ray_tpu.wait([ref], timeout=0)
+    assert time.time() - t0 < 2.0
+    assert ready == [] and not_ready == [ref]
+
+    done = ray_tpu.put(1)
+    ready, not_ready = ray_tpu.wait([done, ref], timeout=0)
+    assert ready == [done] and not_ready == [ref]
+
+
+def test_wait_fetch_local_false_does_not_pull(ray_4cpu):
+    """fetch_local=False answers availability without copying the object
+    into the caller's store; fetch_local=True pulls it."""
+    # Single-node: contains() is immediate; use a cross-node cluster.
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    other = cluster.add_node(num_cpus=2)
+    cluster.connect(object_store_memory=64 * 1024 * 1024)
+    cluster.wait_for_nodes()
+    try:
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        @ray_tpu.remote
+        def produce():
+            return np.ones(1 << 16, np.uint8)
+
+        ref = produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=other.node_id, soft=False)).remote()
+        w = worker_mod.require_worker()
+
+        ready, _ = ray_tpu.wait([ref], timeout=30, fetch_local=False)
+        assert ready == [ref]
+        assert not w.store.contains(ref.binary())  # stayed remote
+
+        ready, _ = ray_tpu.wait([ref], timeout=30, fetch_local=True)
+        assert ready == [ref]
+        deadline = time.time() + 10
+        while not w.store.contains(ref.binary()) and time.time() < deadline:
+            time.sleep(0.05)
+        assert w.store.contains(ref.binary())  # pulled locally
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_wait_duplicate_refs_rejected(ray_4cpu):
+    ref = ray_tpu.put(1)
+    with pytest.raises(ValueError):
+        ray_tpu.wait([ref, ref])
