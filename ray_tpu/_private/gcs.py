@@ -1185,6 +1185,9 @@ class GcsServer:
                 entry.state = ALIVE
                 self._persist_actor(aid)
                 self._reply_actor_waiters(entry)
+                self._publish("actor_state", {
+                    "actor_id": aid.hex(), "state": ALIVE,
+                    "class_name": entry.spec.class_name})
             elif state == DEAD:
                 if p.get("creation_failed"):
                     # __init__ raised: actor is permanently dead
@@ -1218,6 +1221,10 @@ class GcsServer:
             entry.death_cause = cause
             self._reply_actor_waiters(entry)
         self._persist_actor(aid)
+        self._publish("actor_state", {
+            "actor_id": aid.hex(), "state": entry.state,
+            "class_name": entry.spec.class_name,
+            "death_cause": entry.death_cause})
 
     def _reply_actor_waiters(self, entry: ActorEntry):
         waiters, entry.waiters = entry.waiters, []
@@ -1470,6 +1477,40 @@ class GcsServer:
                          "node_id": b.node_id} for b in e.spec.bundles],
                 }
             conn.reply(msg_id, out)
+
+    # --------------------------------------------------------------- pubsub
+
+    def _h_subscribe(self, conn, p, msg_id):
+        """Subscribe this connection to a channel (reference:
+        src/ray/pubsub/publisher.h GcsPublisher channels — actor state,
+        logs, errors; here one generic channel table)."""
+        with self._lock:
+            conn.meta.setdefault("subscriptions", set()).add(p["channel"])
+        conn.reply(msg_id, True)
+
+    def _h_unsubscribe(self, conn, p, msg_id):
+        with self._lock:
+            conn.meta.setdefault("subscriptions", set()).discard(
+                p["channel"])
+        conn.reply(msg_id, True)
+
+    def _h_publish(self, conn, p, msg_id):
+        self._publish(p["channel"], p["message"])
+
+    def _publish(self, channel: str, message):
+        """Push to every subscriber; dead conns are skipped (their
+        subscriptions die with the connection)."""
+        with self._lock:
+            targets = [c for c in self._clients.values()
+                       if channel in c.meta.get("subscriptions", ())]
+            targets += [n.conn for n in self._nodes.values()
+                        if n.alive and channel in
+                        n.conn.meta.get("subscriptions", ())]
+        for c in targets:
+            try:
+                c.notify("pubsub", {"channel": channel, "message": message})
+            except Exception:
+                pass
 
     # ----------------------------------------------------------- worker logs
 

@@ -331,6 +331,14 @@ class CoreWorker:
     # ----------------------------------------------------------- plumbing
 
     def _on_gcs_msg(self, conn, mtype, payload, msg_id):
+        if mtype == "pubsub":
+            fn = _pubsub_dispatch
+            if fn is not None:
+                try:
+                    fn(payload)
+                except Exception:
+                    pass
+            return
         if mtype == "driver_logs" and self._log_to_driver:
             # Re-print remote worker output locally (reference:
             # worker.print_logs / print_to_stdstream, _private/worker.py),
@@ -1125,6 +1133,16 @@ def global_worker() -> Optional[CoreWorker]:
 def set_global_worker(w: CoreWorker):
     global _global_worker
     _global_worker = w
+
+
+_pubsub_dispatch = None
+
+
+def register_pubsub_dispatch(fn) -> None:
+    """Install the process-wide pubsub push handler (set by
+    ray_tpu.experimental.pubsub on first subscribe)."""
+    global _pubsub_dispatch
+    _pubsub_dispatch = fn
 
 
 def require_worker() -> CoreWorker:

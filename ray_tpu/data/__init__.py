@@ -11,6 +11,7 @@ training step (mesh shardings), not the dataset.
 
 from ray_tpu.data.dataset import (  # noqa: F401
     Dataset,
+    DatasetPipeline,
     from_items,
     range as range_,  # noqa: A001
     from_numpy,
@@ -27,7 +28,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
 range = range_  # noqa: A001
 
 __all__ = [
-    "Dataset", "from_items", "range", "from_numpy", "from_pandas",
+    "Dataset", "DatasetPipeline", "from_items", "range", "from_numpy", "from_pandas",
     "from_arrow", "read_text", "read_csv", "read_json", "read_parquet",
     "read_binary_files",
 ]

@@ -303,6 +303,35 @@ class Dataset:
     def groupby(self, key: str) -> "GroupedDataset":
         return GroupedDataset(self, key)
 
+    # ----------------------------------------------------------- pipelines
+
+    def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
+        """Split into a pipeline of windows of input blocks; each window
+        executes only when iteration reaches it (reference:
+        dataset.window -> DatasetPipeline, _internal pipeline executor)."""
+        blocks, stages = self._input_blocks, self._stages
+
+        def windows():
+            for i in builtins.range(0, len(blocks), blocks_per_window):
+                yield Dataset(blocks[i:i + blocks_per_window], stages)
+
+        return DatasetPipeline(windows, length=max(
+            1, (len(blocks) + blocks_per_window - 1) // blocks_per_window))
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        """Epoch pipeline: the dataset repeats ``times`` times (forever
+        when None) — feed ``iter_batches`` straight into a training loop
+        (reference: dataset.repeat)."""
+        ds = self
+
+        def epochs():
+            i = 0
+            while times is None or i < times:
+                yield ds
+                i += 1
+
+        return DatasetPipeline(epochs, length=times)
+
     # --------------------------------------------------------- consumption
 
     def take(self, limit: int = 20) -> List[Any]:
@@ -415,6 +444,66 @@ def _jsonable(row):
     if isinstance(row, np.ndarray):
         return row.tolist()
     return row
+
+
+class DatasetPipeline:
+    """A sequence of Datasets (windows or epochs) executed lazily, one
+    window ahead of the consumer (reference: DatasetPipeline,
+    data/dataset_pipeline.py). Transformations apply per-window."""
+
+    def __init__(self, windows_factory: Callable[[], Iterator["Dataset"]],
+                 length: Optional[int] = None):
+        self._factory = windows_factory
+        self.length = length
+
+    def _map_windows(self, f: Callable[["Dataset"], "Dataset"]
+                     ) -> "DatasetPipeline":
+        factory = self._factory
+
+        def windows():
+            for w in factory():
+                yield f(w)
+
+        return DatasetPipeline(windows, length=self.length)
+
+    def map(self, fn):
+        return self._map_windows(lambda d: d.map(fn))
+
+    def flat_map(self, fn):
+        return self._map_windows(lambda d: d.flat_map(fn))
+
+    def filter(self, fn):
+        return self._map_windows(lambda d: d.filter(fn))
+
+    def map_batches(self, fn, **kw):
+        return self._map_windows(lambda d: d.map_batches(fn, **kw))
+
+    def random_shuffle_each_window(self, *, seed=None):
+        return self._map_windows(
+            lambda d: d.random_shuffle(seed=seed))
+
+    def iter_windows(self) -> Iterator["Dataset"]:
+        return self._factory()
+
+    def iter_rows(self) -> Iterator[Any]:
+        for w in self._factory():
+            yield from w.iter_rows()
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        for w in self._factory():
+            yield from w.iter_batches(**kw)
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for r in self.iter_rows():
+            out.append(r)
+            if len(out) >= limit:
+                break
+        return out
+
+    def __repr__(self):
+        n = "inf" if self.length is None else self.length
+        return f"DatasetPipeline(windows={n})"
 
 
 class GroupedDataset:

@@ -98,3 +98,26 @@ def test_train_ingest_with_dataset_shard(ray_4cpu, tmp_path):
     n0 = result.metrics_history[-1]["n"]
     t0 = result.metrics_history[-1]["total"]
     assert 0 < n0 < 60  # rank 0 got a strict subset (split happened)
+
+
+def test_dataset_pipeline_windows(ray_4cpu):
+    ds = rdata.range(40, parallelism=8)
+    pipe = ds.window(blocks_per_window=2).map(lambda x: x * 2)
+    rows = [r for r in pipe.iter_rows()]
+    assert sorted(rows) == [2 * i for i in range(40)]
+    assert pipe.length == 4
+
+
+def test_dataset_pipeline_repeat_epochs(ray_4cpu):
+    ds = rdata.range(10, parallelism=2)
+    pipe = ds.repeat(3)
+    rows = list(pipe.iter_rows())
+    assert len(rows) == 30
+    assert sorted(set(rows)) == list(range(10))
+
+
+def test_dataset_pipeline_batches_across_windows(ray_4cpu):
+    pipe = rdata.range(24, parallelism=4).window(blocks_per_window=1)
+    batches = list(pipe.iter_batches(batch_size=6))
+    total = sum(len(b["item"]) for b in batches)
+    assert total == 24

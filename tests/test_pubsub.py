@@ -1,0 +1,73 @@
+"""GCS pub/sub channels (reference: src/ray/pubsub/publisher.h +
+ray._private.gcs_pubsub)."""
+
+import queue
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import pubsub
+
+
+@pytest.fixture
+def ray_2cpu():
+    ctx = ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_publish_subscribe_roundtrip(ray_2cpu):
+    sub = pubsub.subscribe("alerts")
+    pubsub.publish("alerts", {"sev": 1, "msg": "hi"})
+    assert sub.get(timeout=10) == {"sev": 1, "msg": "hi"}
+    sub.unsubscribe()
+
+
+def test_publish_from_worker_reaches_driver(ray_2cpu):
+    sub = pubsub.subscribe("events")
+
+    @ray_tpu.remote
+    def announce(i):
+        from ray_tpu.experimental import pubsub as ps
+
+        ps.publish("events", {"i": i})
+        return i
+
+    assert ray_tpu.get(announce.remote(7), timeout=60) == 7
+    assert sub.get(timeout=10) == {"i": 7}
+
+
+def test_actor_state_channel(ray_2cpu):
+    """The GCS publishes actor lifecycle transitions on actor_state."""
+    sub = pubsub.subscribe("actor_state")
+
+    @ray_tpu.remote
+    class Blip:
+        def ping(self):
+            return True
+
+    b = Blip.remote()
+    assert ray_tpu.get(b.ping.remote(), timeout=60)
+    msg = sub.get(timeout=15)
+    assert msg["state"] == "ALIVE"
+    assert msg["class_name"] == "Blip"
+    ray_tpu.kill(b)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            msg = sub.get(timeout=5)
+        except queue.Empty:
+            continue
+        if msg["state"] == "DEAD":
+            return
+    raise AssertionError("never saw the DEAD transition")
+
+
+def test_unsubscribed_channel_silent(ray_2cpu):
+    sub = pubsub.subscribe("chan_a")
+    pubsub.publish("chan_b", "nope")
+    pubsub.publish("chan_a", "yes")
+    assert sub.get(timeout=10) == "yes"
+    with pytest.raises(queue.Empty):
+        sub.get_nowait()
