@@ -1,6 +1,7 @@
 """Reinforcement learning library (reference: ``rllib/`` — ~35 algorithms
 on ``Algorithm(Trainable)`` ``algorithms/algorithm.py:146``; this slice
-ships PPO on the new Learner architecture, SURVEY.md §7 step 8).
+ships PPO (on-policy) and DQN (off-policy replay) on the Learner
+architecture, SURVEY.md §7 step 8).
 
 Architecture (TPU-first version of the reference's split):
 - ``RolloutWorker`` actors sample environments on CPU hosts
@@ -16,8 +17,12 @@ from ray_tpu.rllib.sample_batch import SampleBatch, concat_batches  # noqa: F401
 from ray_tpu.rllib.policy import MLPPolicy, PolicySpec  # noqa: F401
 from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner  # noqa: F401
+from ray_tpu.rllib.dqn import (  # noqa: F401
+    DQN, DQNConfig, DQNLearner, ReplayBuffer,
+)
 
 __all__ = [
     "SampleBatch", "concat_batches", "MLPPolicy", "PolicySpec",
     "RolloutWorker", "PPO", "PPOConfig", "PPOLearner",
+    "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
 ]

@@ -1,0 +1,329 @@
+"""DQN (reference: ``rllib/algorithms/dqn/dqn.py`` — replay-buffer
+off-policy learning; ``dqn_rainbow_learner.py`` for the learner loss and
+``utils/replay_buffers/replay_buffer.py:81`` for the buffer).
+
+TPU-first split mirroring PPO's: epsilon-greedy ``_DQNRolloutWorker``
+actors step environments on CPU hosts; the ``DQNLearner`` runs a jitted
+double-DQN TD update (one compiled XLA program per minibatch) with a
+periodically-synced target network. The replay buffer is a numpy ring
+on the learner host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    env_creator: Optional[Callable[[], Any]] = None
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 100
+    gamma: float = 0.99
+    lr: float = 1e-3
+    buffer_size: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    num_sgd_iters: int = 32          # minibatch updates per train()
+    target_update_freq: int = 200    # in learner updates
+    double_q: bool = True
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 5_000
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    obs_dim: Optional[int] = None
+    num_actions: Optional[int] = None
+
+    def environment(self, env_creator) -> "DQNConfig":
+        self.env_creator = env_creator
+        return self
+
+    def rollouts(self, *, num_rollout_workers: int = None,
+                 rollout_fragment_length: int = None) -> "DQNConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "DQNConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown DQN option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class ReplayBuffer:
+    """Uniform ring buffer (reference: replay_buffer.py:81)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity,), np.int32)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.float32)
+        self._next = 0
+        self.size = 0
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones):
+        for i in range(len(actions)):
+            j = self._next
+            self.obs[j] = obs[i]
+            self.actions[j] = actions[i]
+            self.rewards[j] = rewards[i]
+            self.next_obs[j] = next_obs[i]
+            self.dones[j] = dones[i]
+            self._next = (self._next + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, n: int, rng: np.random.Generator) -> Dict[str, Any]:
+        idx = rng.integers(0, self.size, n)
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx],
+                "next_obs": self.next_obs[idx], "dones": self.dones[idx]}
+
+
+class DQNLearner:
+    """Jitted double-DQN TD update with target network."""
+
+    def __init__(self, spec: PolicySpec, config: DQNConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.policy = MLPPolicy(spec)
+        self.optimizer = optax.adam(config.lr)
+        self.params = self.policy.init(jax.random.key(config.seed))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.opt_state = self.optimizer.init(self.params)
+        self.num_updates = 0
+        self._target_freq = config.target_update_freq
+        gamma, double_q = config.gamma, config.double_q
+
+        def q_values(params, obs):
+            logits, _ = MLPPolicy.forward(params, obs)
+            return logits  # the pi head doubles as the Q head
+
+        def loss_fn(params, target_params, batch):
+            q = q_values(params, batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+            q_next_target = q_values(target_params, batch["next_obs"])
+            if double_q:
+                # Action chosen by the ONLINE net, valued by the target
+                # net (van Hasselt double-DQN).
+                a_star = jnp.argmax(q_values(params, batch["next_obs"]),
+                                    axis=1)
+                next_v = jnp.take_along_axis(
+                    q_next_target, a_star[:, None], axis=1)[:, 0]
+            else:
+                next_v = jnp.max(q_next_target, axis=1)
+            target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+                jax.lax.stop_gradient(next_v)
+            td = q_sel - target
+            # Huber keeps rare large TD errors from dominating.
+            loss = jnp.mean(jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                                      jnp.abs(td) - 0.5))
+            return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
+                          "q_mean": jnp.mean(q_sel)}
+
+        def update(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            aux["loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def update_from_buffer(self, buffer: ReplayBuffer, *, iters: int,
+                           batch_size: int,
+                           rng: np.random.Generator) -> Dict[str, float]:
+        import jax
+
+        aux = {}
+        for _ in range(iters):
+            batch = buffer.sample(min(batch_size, buffer.size), rng)
+            self.params, self.opt_state, aux = self._update(
+                self.params, self.target_params, self.opt_state, batch)
+            self.num_updates += 1
+            if self.num_updates % self._target_freq == 0:
+                self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+
+
+class _DQNRolloutWorker:
+    """Epsilon-greedy environment stepper (CPU actor)."""
+
+    def __init__(self, env_creator, spec: PolicySpec, *,
+                 rollout_fragment_length: int = 100, seed: int = 0):
+        import jax
+
+        self.env = env_creator()
+        self.spec = spec
+        self.fragment = rollout_fragment_length
+        self._np_rng = np.random.default_rng(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._completed: List[float] = []
+
+        def greedy(params, obs):
+            logits, _ = MLPPolicy.forward(params, obs)
+            return jax.numpy.argmax(logits, axis=1)
+
+        self._greedy = jax.jit(greedy)
+
+    def sample(self, params, epsilon: float) -> Dict[str, Any]:
+        obs_b, act_b, rew_b, nxt_b, done_b = [], [], [], [], []
+        for _ in range(self.fragment):
+            obs = np.asarray(self._obs, np.float32)
+            if self._np_rng.random() < epsilon:
+                a = int(self._np_rng.integers(self.spec.num_actions))
+            else:
+                a = int(self._greedy(params, obs[None])[0])
+            nxt, r, term, trunc, _ = self.env.step(a)
+            done = bool(term)  # truncation bootstraps (not a true terminal)
+            obs_b.append(obs)
+            act_b.append(a)
+            rew_b.append(float(r))
+            nxt_b.append(np.asarray(nxt, np.float32))
+            done_b.append(float(done))
+            self._episode_return += float(r)
+            if term or trunc:
+                self._completed.append(self._episode_return)
+                self._episode_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        return {"obs": np.stack(obs_b), "actions": np.asarray(act_b),
+                "rewards": np.asarray(rew_b, np.float32),
+                "next_obs": np.stack(nxt_b),
+                "dones": np.asarray(done_b, np.float32)}
+
+    def episode_returns(self) -> List[float]:
+        out, self._completed = self._completed, []
+        return out
+
+
+class DQN:
+    """The Algorithm (reference: dqn.py DQN(Algorithm) training_step:
+    sample -> store -> replay-train -> target sync)."""
+
+    def __init__(self, config: DQNConfig):
+        import ray_tpu
+
+        if config.env_creator is None:
+            raise ValueError("DQNConfig.environment(env_creator) required")
+        self.config = config
+        if config.obs_dim is None or config.num_actions is None:
+            probe = config.env_creator()
+            config.obs_dim = int(np.prod(probe.observation_space.shape))
+            config.num_actions = int(probe.action_space.n)
+            close = getattr(probe, "close", None)
+            if close:
+                close()
+        self.spec = PolicySpec(config.obs_dim, config.num_actions,
+                               config.hidden)
+        self.learner = DQNLearner(self.spec, config)
+        self.buffer = ReplayBuffer(config.buffer_size, config.obs_dim)
+        self._np_rng = np.random.default_rng(config.seed)
+        self.total_env_steps = 0
+        self.iteration = 0
+
+        worker_cls = ray_tpu.remote(_DQNRolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=1).remote(
+                config.env_creator, self.spec,
+                rollout_fragment_length=config.rollout_fragment_length,
+                seed=config.seed + 1 + i)
+            for i in range(config.num_rollout_workers)
+        ]
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self.total_env_steps / max(1, c.epsilon_decay_steps))
+        return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        t0 = time.perf_counter()
+        eps = self._epsilon()
+        weights = self.learner.get_weights()
+        batches = ray_tpu.get(
+            [w.sample.remote(weights, eps) for w in self.workers])
+        for b in batches:
+            self.buffer.add_batch(b["obs"], b["actions"], b["rewards"],
+                                  b["next_obs"], b["dones"])
+            self.total_env_steps += len(b["actions"])
+        learn_metrics: Dict[str, float] = {}
+        if self.buffer.size >= self.config.learning_starts:
+            learn_metrics = self.learner.update_from_buffer(
+                self.buffer, iters=self.config.num_sgd_iters,
+                batch_size=self.config.train_batch_size, rng=self._np_rng)
+        returns: List[float] = []
+        for r in ray_tpu.get(
+                [w.episode_returns.remote() for w in self.workers]):
+            returns.extend(r)
+        dt = time.perf_counter() - t0
+        steps = sum(len(b["actions"]) for b in batches)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self.total_env_steps,
+            "timesteps_this_iter": steps,
+            "env_steps_per_sec": steps / dt,
+            "epsilon": eps,
+            "buffer_size": self.buffer.size,
+            "episode_return_mean": float(np.mean(returns))
+            if returns else None,
+            **learn_metrics,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self):
+        import ray_tpu
+
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    @classmethod
+    def as_trainable(cls, base_config: "DQNConfig",
+                     stop_iters: int = 10) -> Callable:
+        def trainable(tune_config: Dict[str, Any]):
+            from ray_tpu.train import session
+
+            cfg = dataclasses.replace(base_config, **tune_config)
+            algo = cls(cfg)
+            try:
+                for _ in range(stop_iters):
+                    session.report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

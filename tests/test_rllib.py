@@ -91,3 +91,55 @@ def test_ppo_cartpole_learns(ray_cluster):
     algo.stop()
     # CartPole returns should clearly improve over ~13 iterations
     assert max(returns[-3:]) > returns[0] + 20, returns
+
+
+def test_dqn_learner_reduces_td_error():
+    """The jitted double-DQN update fits a fixed batch."""
+    from ray_tpu.rllib import DQNConfig, DQNLearner, ReplayBuffer
+    from ray_tpu.rllib.policy import PolicySpec
+
+    rng = np.random.default_rng(0)
+    spec = PolicySpec(obs_dim=4, num_actions=2)
+    # gamma=0 makes the TD target the (fixed) reward — a supervised
+    # regression whose loss must fall monotonically-ish.
+    cfg = DQNConfig(lr=3e-3, gamma=0.0, target_update_freq=20)
+    learner = DQNLearner(spec, cfg)
+    buf = ReplayBuffer(1024, 4)
+    obs = rng.normal(size=(512, 4)).astype(np.float32)
+    acts = rng.integers(0, 2, 512)
+    rews = (obs[np.arange(512), acts % 4] > 0).astype(np.float32)
+    buf.add_batch(obs, acts, rews, obs, np.zeros(512, np.float32))
+
+    m1 = learner.update_from_buffer(buf, iters=5, batch_size=128, rng=rng)
+    for _ in range(20):
+        m2 = learner.update_from_buffer(buf, iters=5, batch_size=128,
+                                        rng=rng)
+    assert m2["loss"] < m1["loss"]
+
+
+def test_dqn_cartpole_improves(ray_cluster):
+    """End-to-end DQN: epsilon-greedy rollout actors feeding the replay
+    learner; the return trend must beat the random baseline."""
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig()
+            .environment(_cartpole)
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=200)
+            .training(lr=1e-3, learning_starts=400, num_sgd_iters=48,
+                      train_batch_size=64, target_update_freq=100,
+                      epsilon_decay_steps=3000, seed=0)
+            .build())
+    try:
+        first = None
+        for i in range(12):
+            res = algo.train()
+            if res["episode_return_mean"] is not None and first is None:
+                first = res["episode_return_mean"]
+        last = res["episode_return_mean"]
+        assert res["timesteps_total"] >= 4000
+        assert res["buffer_size"] > 1000
+        assert res["epsilon"] < 0.5  # schedule advanced
+        # CartPole random play scores ~20; learning should clearly beat it.
+        assert last is not None and last > 40, (first, last)
+    finally:
+        algo.stop()
