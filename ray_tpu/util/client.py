@@ -1,0 +1,263 @@
+"""Remote-driver client (reference: Ray Client —
+``util/client/server/proxier.py:113`` proxies a thin driver into the
+cluster; ``util/client/worker.py`` is the client side).
+
+Why it exists here: ``ray_tpu.init(address=...)`` requires the driver to
+mmap the head's shared-memory store, so it only works on a cluster host.
+The client mode below needs nothing but a TCP route to the head: a
+``ClientServer`` runs next to the head GCS and executes driver API calls
+on the thin client's behalf; values and function blobs cross the wire,
+object refs cross as ids and stay pinned server-side for the session.
+
+Server (on a cluster host, after ray_tpu.init):
+
+    from ray_tpu.util.client import ClientServer
+    srv = ClientServer(port=10001)
+
+Client (anywhere):
+
+    from ray_tpu.util.client import connect
+    c = conn = connect("head:10001")
+    ref = c.submit(lambda x: x * 2, 21)
+    assert c.get(ref) == 42
+    h = c.create_actor(Counter)
+    c.get(c.call_actor(h, "incr"))
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import protocol
+
+
+class ClientServer:
+    """Executes driver API calls for thin clients (reference:
+    proxier.py:113 — one proxied driver state per client connection)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        import ray_tpu  # the ambient driver this proxy fronts
+
+        self._ray = ray_tpu
+        # Per-connection pinned refs / actor handles: dropping the client
+        # connection unpins everything it created (the reference kills the
+        # proxied driver on disconnect).
+        self._lock = threading.Lock()
+        self.server = protocol.Server(self._handle, host=host, port=port,
+                                      name="client-proxy")
+        self.server.on_disconnect = self._on_disconnect
+        self.address = self.server.address
+
+    def close(self):
+        self.server.close()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _state(self, conn) -> Dict[str, Any]:
+        st = conn.meta.get("client_state")
+        if st is None:
+            st = {"refs": {}, "actors": {}}
+            conn.meta["client_state"] = st
+        return st
+
+    def _on_disconnect(self, conn):
+        st = conn.meta.get("client_state")
+        if not st:
+            return
+        for h in st["actors"].values():
+            try:
+                self._ray.kill(h)
+            except Exception:
+                pass
+        st["refs"].clear()
+
+    def _handle(self, conn, mtype, payload, msg_id):
+        try:
+            fn = getattr(self, "_h_" + mtype, None)
+            if fn is None:
+                conn.reply_error(msg_id, f"client-proxy: unknown {mtype}")
+                return
+            conn.reply(msg_id, fn(conn, payload))
+        except Exception as e:
+            try:
+                conn.reply_error(msg_id, f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+
+    def _pin(self, conn, refs) -> List[bytes]:
+        st = self._state(conn)
+        out = []
+        for r in refs:
+            st["refs"][r.binary()] = r
+            out.append(r.binary())
+        return out
+
+    def _resolve(self, conn, id_bytes: bytes):
+        ref = self._state(conn)["refs"].get(id_bytes)
+        if ref is None:
+            from ray_tpu._private.worker import ObjectRef
+            from ray_tpu._private.ids import ObjectID
+
+            ref = ObjectRef(ObjectID(id_bytes))
+        return ref
+
+    # ------------------------------------------------------------ handlers
+
+    def _h_ping(self, conn, p):
+        return {"ok": True,
+                "nodes": len([n for n in self._ray.nodes() if n["Alive"]])}
+
+    def _h_put(self, conn, p):
+        ref = self._ray.put(cloudpickle.loads(p["blob"]))
+        return self._pin(conn, [ref])[0]
+
+    def _h_get(self, conn, p):
+        refs = [self._resolve(conn, i) for i in p["ids"]]
+        values = self._ray.get(refs, timeout=p.get("timeout"))
+        return cloudpickle.dumps(values)
+
+    def _h_wait(self, conn, p):
+        refs = [self._resolve(conn, i) for i in p["ids"]]
+        ready, not_ready = self._ray.wait(
+            refs, num_returns=p["num_returns"], timeout=p.get("timeout"))
+        return {"ready": [r.binary() for r in ready],
+                "not_ready": [r.binary() for r in not_ready]}
+
+    def _h_submit(self, conn, p):
+        fn = cloudpickle.loads(p["fn"])
+        args, kwargs = cloudpickle.loads(p["args"])
+        opts = p.get("options") or {}
+        remote_fn = self._ray.remote(fn)
+        if opts:
+            remote_fn = remote_fn.options(**opts)
+        refs = remote_fn.remote(*args, **kwargs)
+        if not isinstance(refs, list):
+            refs = [refs]
+        return self._pin(conn, refs)
+
+    def _h_create_actor(self, conn, p):
+        cls = cloudpickle.loads(p["cls"])
+        args, kwargs = cloudpickle.loads(p["args"])
+        opts = p.get("options") or {}
+        remote_cls = self._ray.remote(cls)
+        if opts:
+            remote_cls = remote_cls.options(**opts)
+        handle = remote_cls.remote(*args, **kwargs)
+        hid = uuid.uuid4().hex
+        self._state(conn)["actors"][hid] = handle
+        return hid
+
+    def _h_call_actor(self, conn, p):
+        handle = self._state(conn)["actors"].get(p["handle"])
+        if handle is None:
+            raise KeyError(f"unknown actor handle {p['handle']}")
+        args, kwargs = cloudpickle.loads(p["args"])
+        refs = getattr(handle, p["method"]).remote(*args, **kwargs)
+        if not isinstance(refs, list):
+            refs = [refs]
+        return self._pin(conn, refs)
+
+    def _h_kill_actor(self, conn, p):
+        handle = self._state(conn)["actors"].pop(p["handle"], None)
+        if handle is not None:
+            self._ray.kill(handle)
+        return True
+
+
+class ClientObjectRef:
+    """Client-side stand-in for an ObjectRef (an id the proxy pinned)."""
+
+    __slots__ = ("id",)
+
+    def __init__(self, id_bytes: bytes):
+        self.id = id_bytes
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.id.hex()[:16]})"
+
+
+class ClientActorHandle:
+    def __init__(self, client: "RayTpuClient", hid: str):
+        self._client = client
+        self._hid = hid
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def call(*args, **kwargs):
+            return self._client.call_actor(self._hid, method,
+                                           *args, **kwargs)
+
+        return call
+
+
+class RayTpuClient:
+    """Thin remote driver: every API call executes inside the cluster."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        self._conn = protocol.connect(address, name="rtpu-client",
+                                      timeout=timeout)
+        self.cluster_info = self._conn.request("ping", {})
+
+    def put(self, value) -> ClientObjectRef:
+        return ClientObjectRef(self._conn.request(
+            "put", {"blob": cloudpickle.dumps(value)}))
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        if single:
+            refs = [refs]
+        blob = self._conn.request("get", {
+            "ids": [r.id for r in refs], "timeout": timeout},
+            timeout=(timeout + 30) if timeout else None)
+        values = cloudpickle.loads(blob)
+        return values[0] if single else values
+
+    def wait(self, refs, num_returns: int = 1,
+             timeout: Optional[float] = None
+             ) -> Tuple[List[ClientObjectRef], List[ClientObjectRef]]:
+        reply = self._conn.request("wait", {
+            "ids": [r.id for r in refs], "num_returns": num_returns,
+            "timeout": timeout})
+        return ([ClientObjectRef(i) for i in reply["ready"]],
+                [ClientObjectRef(i) for i in reply["not_ready"]])
+
+    def submit(self, fn, *args, options: Optional[dict] = None,
+               **kwargs):
+        ids = self._conn.request("submit", {
+            "fn": cloudpickle.dumps(fn),
+            "args": cloudpickle.dumps((args, kwargs)),
+            "options": options})
+        refs = [ClientObjectRef(i) for i in ids]
+        return refs[0] if len(refs) == 1 else refs
+
+    def create_actor(self, cls, *args, options: Optional[dict] = None,
+                     **kwargs) -> ClientActorHandle:
+        hid = self._conn.request("create_actor", {
+            "cls": cloudpickle.dumps(cls),
+            "args": cloudpickle.dumps((args, kwargs)),
+            "options": options})
+        return ClientActorHandle(self, hid)
+
+    def call_actor(self, hid: str, method: str, *args, **kwargs):
+        ids = self._conn.request("call_actor", {
+            "handle": hid, "method": method,
+            "args": cloudpickle.dumps((args, kwargs))})
+        refs = [ClientObjectRef(i) for i in ids]
+        return refs[0] if len(refs) == 1 else refs
+
+    def kill_actor(self, handle: ClientActorHandle):
+        self._conn.request("kill_actor", {"handle": handle._hid})
+
+    def disconnect(self):
+        self._conn.close()
+
+
+def connect(address: str, timeout: float = 30.0) -> RayTpuClient:
+    """Connect a thin remote driver to a head-side ClientServer."""
+    return RayTpuClient(address, timeout=timeout)
