@@ -107,6 +107,94 @@ def cmd_start(args) -> int:
     os._exit(0)
 
 
+_UP_PID_FILE = "/tmp/ray_tpu_up.pid"
+_UP_ADDR_FILE = "/tmp/ray_tpu_up.addr"
+
+
+def cmd_up(args) -> int:
+    """Launch a cluster from a YAML config — head + autoscaler + node
+    provider (reference: ``ray up``, autoscaler/_private/commands.py)."""
+    from ray_tpu.autoscaler.cluster_launcher import load_cluster_config
+
+    config = load_cluster_config(args.config)
+    if os.path.exists(_UP_PID_FILE):
+        pid = int(open(_UP_PID_FILE).read())
+        try:
+            os.kill(pid, 0)
+            print(f"cluster already up (pid {pid}, "
+                  f"address {open(_UP_ADDR_FILE).read().strip()})")
+            return 1
+        except OSError:
+            os.unlink(_UP_PID_FILE)
+    try:
+        os.unlink(_UP_ADDR_FILE)
+    except OSError:
+        pass
+
+    pid = os.fork()
+    if pid > 0:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if os.path.exists(_UP_ADDR_FILE):
+                addr = open(_UP_ADDR_FILE).read().strip()
+                print(f"cluster '{config.get('cluster_name', '?')}' up "
+                      f"at {addr}")
+                print(f"connect with ray_tpu.init(address='{addr}')")
+                return 0
+            time.sleep(0.2)
+        print("cluster did not come up within 60s", file=sys.stderr)
+        return 1
+
+    os.setsid()
+    log_fd = os.open("/tmp/ray_tpu_up.log",
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    null_fd = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(null_fd, 0)
+    os.dup2(log_fd, 1)
+    os.dup2(log_fd, 2)
+    os.close(null_fd)
+    os.close(log_fd)
+    from ray_tpu.autoscaler.cluster_launcher import launch_cluster
+
+    launched = launch_cluster(config)
+    with open(_UP_PID_FILE, "w") as f:
+        f.write(str(os.getpid()))
+    with open(_UP_ADDR_FILE, "w") as f:
+        f.write(launched.address)
+    stop = {"flag": False}
+
+    def on_term(sig, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    while not stop["flag"]:
+        time.sleep(0.5)
+    launched.shutdown()
+    for pth in (_UP_PID_FILE, _UP_ADDR_FILE):
+        try:
+            os.unlink(pth)
+        except OSError:
+            pass
+    os._exit(0)
+
+
+def cmd_down(args) -> int:
+    """Tear down a `up`-launched cluster (reference: ``ray down``)."""
+    if not os.path.exists(_UP_PID_FILE):
+        print("no launched cluster")
+        return 1
+    pid = int(open(_UP_PID_FILE).read())
+    try:
+        os.kill(pid, signal.SIGTERM)
+        print(f"stopping cluster (pid {pid})")
+    except OSError as e:
+        print(f"cluster pid {pid} not running ({e})")
+    deadline = time.time() + 30
+    while time.time() < deadline and os.path.exists(_UP_PID_FILE):
+        time.sleep(0.2)
+    return 0
+
+
 def cmd_stop(args) -> int:
     if not os.path.exists(_PID_FILE):
         print("no head running")
@@ -200,6 +288,13 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("stop")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("up")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("status")
     p.add_argument("--address", default=None)

@@ -1,0 +1,65 @@
+"""Standalone worker-node daemon (reference: ``ray start
+--address=<head>`` spawning a raylet that joins an existing cluster,
+services.py start_raylet).
+
+    python -m ray_tpu.scripts.node_daemon --gcs-address HOST:PORT \
+        [--num-cpus N] [--num-tpus N] [--resources '{"k": 1}'] \
+        [--object-store-memory BYTES] [--session-dir DIR]
+
+Runs a NodeManager until SIGTERM/SIGINT, then tears it down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ray_tpu-node")
+    ap.add_argument("--gcs-address", required=True)
+    ap.add_argument("--num-cpus", type=float, default=2)
+    ap.add_argument("--num-tpus", type=float, default=0)
+    ap.add_argument("--resources", default="{}")
+    ap.add_argument("--object-store-memory", type=int, default=256 << 20)
+    ap.add_argument("--session-dir", default="")
+    ap.add_argument("--node-name", default="node")
+    args = ap.parse_args(argv)
+
+    from ray_tpu._private.node_manager import NodeManager
+
+    session_dir = args.session_dir or os.path.join(
+        tempfile.gettempdir(), "ray_tpu",
+        f"node_{int(time.time() * 1000)}_{os.getpid()}")
+    nm = NodeManager(
+        gcs_address=args.gcs_address,
+        session_dir=session_dir,
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        resources=json.loads(args.resources) or None,
+        object_store_memory=args.object_store_memory,
+        is_head=False,
+        node_name=args.node_name,
+    )
+    print(f"node {nm.node_id[:12]} joined {args.gcs_address}", flush=True)
+
+    stop = {"flag": False}
+
+    def on_term(sig, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    while not stop["flag"] and not nm._shutdown:
+        time.sleep(0.2)
+    nm.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Cluster YAML launcher + process-backed node provider (reference:
+``ray up`` / autoscaler commands.py; local node provider)."""
+
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.cluster_launcher import (
+    launch_cluster, load_cluster_config,
+)
+
+
+def test_yaml_launch_min_workers_and_autoscale(tmp_path):
+    cfg_file = tmp_path / "cluster.yaml"
+    cfg_file.write_text(textwrap.dedent("""
+        cluster_name: t
+        max_workers: 3
+        idle_timeout_s: 300
+        update_interval_s: 0.2
+        provider:
+          type: local_process
+          object_store_memory: 67108864
+        head_node_type:
+          CPU: 1
+        available_node_types:
+          cpu_worker:
+            resources: {CPU: 2}
+            min_workers: 1
+            max_workers: 3
+    """))
+    config = load_cluster_config(str(cfg_file))
+    launched = launch_cluster(config)
+    try:
+        ray_tpu.init(address=launched.address)
+        # min_workers=1: a second node (real OS process) joins the head.
+        deadline = time.time() + 60
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.require_worker()
+        while time.time() < deadline:
+            if sum(1 for n in w.nodes() if n["Alive"]) >= 2:
+                break
+            time.sleep(0.2)
+        assert sum(1 for n in w.nodes() if n["Alive"]) >= 2
+
+        # Demand beyond current capacity scales up within max_workers.
+        @ray_tpu.remote(num_cpus=2)
+        def hold():
+            time.sleep(3)
+            return 1
+
+        refs = [hold.remote() for _ in range(4)]
+        assert ray_tpu.get(refs, timeout=120) == [1] * 4
+        assert len(launched.provider.non_terminated_nodes()) >= 2
+    finally:
+        ray_tpu.shutdown()
+        launched.shutdown()
+
+
+def test_bad_yaml_rejected(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("cluster_name: x\n")
+    with pytest.raises(ValueError):
+        load_cluster_config(str(bad))
