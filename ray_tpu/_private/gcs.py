@@ -401,6 +401,8 @@ class GcsServer:
             node = self._nodes.get(p["node_id"])
             if node is not None:
                 node.last_heartbeat = time.time()
+                if "oom_kills" in p:
+                    node.labels["oom_kills"] = str(p["oom_kills"])
 
     def _expire_recovering_actors(self, now: float):
         due = [aid for aid, t in self._recovering_actors.items() if now >= t]
@@ -1581,7 +1583,10 @@ class GcsServer:
                             "locations": sorted(nodes),
                             "size": self._obj_sizes.get(oid, 0),
                             "failed": oid in self._failed_objects,
-                            "spilled_url": spill["url"] if spill else None})
+                            "spilled_url": spill["url"] if spill else None,
+                            "refcount": self._refcount_total(oid),
+                            "pinned_by_tasks":
+                                self._task_arg_pins.get(oid, 0)})
             conn.reply(msg_id, out)
 
     def _h_list_jobs(self, conn, p, msg_id):

@@ -365,7 +365,9 @@ class NodeManager:
         while not self._shutdown:
             time.sleep(period)
             try:
-                self.gcs.notify("heartbeat", {"node_id": self.node_id})
+                self.gcs.notify("heartbeat", {
+                    "node_id": self.node_id,
+                    "oom_kills": getattr(self, "oom_kills", 0)})
             except Exception:
                 pass  # disconnected; the rejoin path owns recovery
 
