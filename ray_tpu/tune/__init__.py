@@ -14,6 +14,7 @@ from ray_tpu.tune.search import (  # noqa: F401
 )
 from ray_tpu.tune.schedulers import (  # noqa: F401
     FIFOScheduler, AsyncHyperBandScheduler, ASHAScheduler,
+    PopulationBasedTraining,
 )
 from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid  # noqa: F401
 from ray_tpu.train.session import report  # noqa: F401  (tune.report alias)
@@ -21,6 +22,7 @@ from ray_tpu.train.session import report  # noqa: F401  (tune.report alias)
 __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "sample_from", "BasicVariantGenerator", "FIFOScheduler",
-    "AsyncHyperBandScheduler", "ASHAScheduler", "TuneConfig", "Tuner",
+    "AsyncHyperBandScheduler", "ASHAScheduler", "PopulationBasedTraining",
+    "TuneConfig", "Tuner",
     "ResultGrid", "report",
 ]

@@ -1,17 +1,28 @@
 """Trial schedulers (reference: ``tune/schedulers/``: FIFO, ASHA
-``async_hyperband.py:17``).
+``async_hyperband.py:17``, PBT ``pbt.py:310``).
 
-The scheduler sees every reported result and decides CONTINUE or STOP;
-ASHA keeps the top ``1/reduction_factor`` of trials at each rung.
+The scheduler sees every reported result and decides CONTINUE, STOP, or
+(PBT) an ``Exploit``: the runner then restarts the trial from the donor
+trial's checkpoint with a mutated config.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import random
 from collections import defaultdict
-from typing import Dict
+from typing import Any, Callable, Dict, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+
+
+@dataclasses.dataclass
+class Exploit:
+    """PBT decision: clone ``donor``'s checkpoint, run with ``config``."""
+
+    donor: str
+    config: Dict[str, Any]
 
 
 class FIFOScheduler:
@@ -66,3 +77,77 @@ class AsyncHyperBandScheduler:
 
 
 ASHAScheduler = AsyncHyperBandScheduler
+
+
+class PopulationBasedTraining:
+    """PBT (reference: ``tune/schedulers/pbt.py:310``
+    PopulationBasedTraining._exploit/_explore): every
+    ``perturbation_interval`` iterations, a bottom-quantile trial clones a
+    top-quantile trial's checkpoint and continues with a perturbed copy of
+    the donor's hyperparameters."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 time_attr: str = "training_iteration", seed: int = 0):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, int] = {}
+        self.num_exploits = 0
+
+    # Runner hook: configs are needed to mutate the donor's.
+    def on_trial_add(self, trial_id: str, config: Dict[str, Any]):
+        self._configs[trial_id] = dict(config)
+
+    def _sample(self, spec) -> Any:
+        if callable(spec) and not hasattr(spec, "sample"):
+            return spec()
+        if hasattr(spec, "sample"):
+            return spec.sample(self._rng)
+        return self._rng.choice(list(spec))
+
+    def _explore(self, donor_cfg: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = dict(donor_cfg)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_p or \
+                    not isinstance(cfg.get(key), (int, float)):
+                cfg[key] = self._sample(spec)
+            else:
+                cfg[key] = cfg[key] * self._rng.choice((0.8, 1.2))
+        return cfg
+
+    def on_result(self, trial_id: str, result: Dict):
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if value is None or t is None:
+            return CONTINUE
+        self._scores[trial_id] = float(value)
+        if t - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        scored = sorted(
+            self._scores.items(), key=lambda kv: kv[1],
+            reverse=(self.mode == "max"))
+        if len(scored) < 2:
+            return CONTINUE
+        k = max(1, int(len(scored) * self.quantile))
+        top = [tid for tid, _ in scored[:k]]
+        bottom = {tid for tid, _ in scored[-k:]}
+        if trial_id not in bottom or trial_id in top:
+            return CONTINUE
+        donor = self._rng.choice(top)
+        new_cfg = self._explore(self._configs.get(donor, {}))
+        self._configs[trial_id] = new_cfg
+        self.num_exploits += 1
+        return Exploit(donor=donor, config=new_cfg)

@@ -22,7 +22,9 @@ import cloudpickle
 import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import Result, RunConfig
-from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.schedulers import (
+    CONTINUE, Exploit, FIFOScheduler, STOP,
+)
 from ray_tpu.tune.search import BasicVariantGenerator
 
 _POLL_PERIOD_S = 0.05
@@ -136,6 +138,9 @@ class Tuner:
             fn_blob = cloudpickle.dumps(self._trainable)
 
         scheduler = self.tune_config.scheduler or FIFOScheduler()
+        if hasattr(scheduler, "on_trial_add"):
+            for t in trials:
+                scheduler.on_trial_add(t.trial_id, t.config)
         res = self.tune_config.resources_per_trial or {"CPU": 1.0}
         max_conc = self.tune_config.max_concurrent_trials or \
             max(1, len(trials))
@@ -165,6 +170,7 @@ class Tuner:
             polls = ray_tpu.get([t.actor.poll.remote() for t in running])
             for trial, st in zip(running, polls):
                 stop = False
+                exploit = None
                 for rep in st["reports"]:
                     trial.iteration += 1
                     metrics = dict(rep["metrics"])
@@ -175,8 +181,23 @@ class Tuner:
                                            f"checkpoint_{trial.iteration:06d}")
                         trial.checkpoint = Checkpoint(
                             rep["checkpoint_path"]).move_to(dst)
-                    if scheduler.on_result(trial.trial_id, metrics) == STOP:
+                    decision = scheduler.on_result(trial.trial_id, metrics)
+                    if decision == STOP:
                         stop = True
+                    elif isinstance(decision, Exploit):
+                        exploit = decision
+                if exploit is not None and st["state"] == "running":
+                    # PBT exploit/explore: restart from the donor's
+                    # checkpoint with the mutated config (reference:
+                    # pbt.py _exploit cloning trial state).
+                    self._stop_actor(trial)
+                    donor = next((t for t in trials
+                                  if t.trial_id == exploit.donor), None)
+                    if donor is not None and donor.checkpoint is not None:
+                        trial.checkpoint = donor.checkpoint
+                    trial.config = dict(exploit.config)
+                    trial.state = "PENDING"
+                    continue
                 if st["state"] == "errored":
                     self._stop_actor(trial)
                     if max_failures < 0 or trial.retries < max_failures:

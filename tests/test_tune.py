@@ -114,3 +114,42 @@ def test_trial_retry(ray_4cpu, tmp_path):
     grid = tuner.fit()
     assert not grid.errors
     assert grid.get_best_result().metrics["loss"] == 1.0
+
+
+def test_pbt_exploits_toward_better_config(ray_4cpu, tmp_path):
+    """PBT: bottom-quantile trials clone the leader's checkpoint and
+    continue with a perturbed copy of its hyperparameters — the
+    population's final scores must beat the worst initial lr's ceiling."""
+    import time as _time
+
+    from ray_tpu import train
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.tune import PopulationBasedTraining, TuneConfig, Tuner
+
+    def train_fn(config):
+        ckpt = train.get_checkpoint()
+        score = ckpt.to_dict()["score"] if ckpt else 0.0
+        for _ in range(25):
+            score += config["lr"]  # higher lr -> faster score growth
+            train.report({"score": score},
+                         checkpoint=Checkpoint.from_dict({"score": score}))
+            _time.sleep(0.12)
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": lambda: 1.0}, quantile_fraction=0.34,
+        seed=0)
+    tuner = Tuner(
+        train_fn,
+        param_space={"lr": tune.grid_search([0.01, 0.02, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt,
+                               num_samples=1),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert pbt.num_exploits >= 1, "PBT never exploited"
+    scores = sorted(r.metrics["score"] for r in grid)
+    # The low-lr trials top out at 25*0.02 = 0.5 on their own; an
+    # exploited trial clones the lr=1.0 leader's checkpoint + config, so
+    # at least one laggard must end far above its solo ceiling.
+    assert scores[1] > 1.0, scores
