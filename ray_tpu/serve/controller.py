@@ -143,18 +143,24 @@ class ServeController:
         stats_by_replica: Dict[int, dict] = {}
         for st, r, ref in probes:
             key = id(r)
+            dead = False
             if ref is not None and id(ref) in ready_set:
                 try:
                     stats_by_replica[key] = ray_tpu.get(ref, timeout=1)
                     self._miss_counts.pop(key, None)
                     continue
+                except (ray_tpu.exceptions.RayActorError,
+                        ray_tpu.exceptions.WorkerCrashedError):
+                    # Conclusive: the replica process is gone. Replace it
+                    # NOW — miss-counting is only for slow replicas.
+                    dead = True
                 except Exception:
                     pass
             # Missed probe: a busy replica (long user request) also misses —
             # only replace after sustained misses, and KILL the old actor so
             # a merely-slow replica can't leak and double capacity.
             self._miss_counts[key] = self._miss_counts.get(key, 0) + 1
-            if self._miss_counts[key] >= _MAX_PROBE_MISSES:
+            if dead or self._miss_counts[key] >= _MAX_PROBE_MISSES:
                 self._miss_counts.pop(key, None)
                 with self._lock:
                     if r in st.replicas:

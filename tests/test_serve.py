@@ -149,3 +149,51 @@ def test_replica_failure_recovery(serve_cluster):
         time.sleep(0.5)
     else:
         raise AssertionError("replica never recovered")
+
+
+def test_autoscaler_smoothing_ignores_single_spike():
+    """One bursty queue-depth sample inside the look-back window must not
+    change the target; a sustained load must (reference:
+    autoscaling_policy.py:54-70 look-back averaging)."""
+    from ray_tpu.serve.controller import _DeploymentState
+
+    class _Ctl:
+        """Borrow the real _autoscale_one logic on a fake controller."""
+
+        def __init__(self):
+            import threading
+
+            self._lock = threading.Lock()
+
+        from ray_tpu.serve.controller import ServeController
+
+        _autoscale_one = ServeController._autoscale_one
+
+    ac = {"min_replicas": 1, "max_replicas": 8,
+          "target_ongoing_requests": 1.0,
+          "upscale_delay_s": 0.0, "downscale_delay_s": 0.0,
+          "look_back_period_s": 10.0}
+    st = _DeploymentState({"num_replicas": 1, "autoscaling_config": ac},
+                          b"", (), {})
+
+    class _R:  # stand-in replica handles
+        pass
+
+    st.replicas = [_R()]
+    st.target = 1
+    ctl = _Ctl()
+
+    # 5 idle samples then one spike of 8: the window average (~1.3) must
+    # keep the target low.
+    now = 1000.0
+    for i in range(5):
+        stats = {id(st.replicas[0]): {"ongoing": 0}}
+        ctl._autoscale_one(st, stats, now + i)
+    ctl._autoscale_one(st, {id(st.replicas[0]): {"ongoing": 8}}, now + 5)
+    assert st.target <= 2, st.target
+
+    # Sustained load fills the window: now it must scale up.
+    for i in range(12):
+        ctl._autoscale_one(st, {id(st.replicas[0]): {"ongoing": 8}},
+                           now + 6 + i)
+    assert st.target >= 4, st.target
