@@ -120,8 +120,13 @@ _d("scheduler_spread_threshold", 0.5,
    "(reference: ray_config_def.h:193).")
 _d("worker_idle_timeout_s", 300.0, "Idle workers above the soft limit exit.")
 _d("raylet_heartbeat_period_ms", 1000, "Node -> GCS liveness report period.")
+_d("health_check_period_ms", 3000,
+   "Health-check evaluation period: the death budget is threshold * this "
+   "(reference: ray_config_def.h health_check_period_ms=3000).")
 _d("health_check_failure_threshold", 5,
-   "Missed health checks before the GCS declares a node dead.")
+   "Missed health checks before the GCS declares a node dead "
+   "(reference default 5 -> a 15s budget; a node must be silent that "
+   "long while its socket stays open to be declared dead).")
 
 # --- distributed refcounting / lineage -------------------------------------
 _d("refcount_enabled", True,

@@ -381,7 +381,8 @@ class GcsServer:
         from ray_tpu._private.config import config as _cfg
 
         period = _cfg.raylet_heartbeat_period_ms / 1000.0
-        budget = max(_cfg.health_check_failure_threshold * period, 2.0)
+        budget = max(_cfg.health_check_failure_threshold *
+                     (_cfg.health_check_period_ms / 1000.0), 2.0)
         # If the GCS itself was descheduled (compile pauses in test
         # processes), don't blame the nodes for the gap.
         gap = now - self._last_tick

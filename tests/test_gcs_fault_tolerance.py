@@ -128,8 +128,10 @@ def test_health_check_marks_wedged_node_dead(tmp_path):
     from ray_tpu._private.config import config
 
     old_period = config.raylet_heartbeat_period_ms
+    old_hc = config.health_check_period_ms
     old_thresh = config.health_check_failure_threshold
     config.set("raylet_heartbeat_period_ms", 100)
+    config.set("health_check_period_ms", 100)
     config.set("health_check_failure_threshold", 5)
     try:
         gcs = GcsServer()
@@ -149,6 +151,7 @@ def test_health_check_marks_wedged_node_dead(tmp_path):
             msg="gcs declared the silent node dead")
     finally:
         config.set("raylet_heartbeat_period_ms", old_period)
+        config.set("health_check_period_ms", old_hc)
         config.set("health_check_failure_threshold", old_thresh)
         try:
             nm._shutdown = False
