@@ -43,6 +43,7 @@ class ServeController:
     def __init__(self, http_port: Optional[int] = None):
         self._deployments: Dict[str, _DeploymentState] = {}
         self._miss_counts: Dict[int, int] = {}
+        self._dead_counts: Dict[int, int] = {}
         self._lock = threading.RLock()
         self._running = True
         self._http_port = http_port
@@ -148,12 +149,18 @@ class ServeController:
                 try:
                     stats_by_replica[key] = ray_tpu.get(ref, timeout=1)
                     self._miss_counts.pop(key, None)
+                    self._dead_counts.pop(key, None)
                     continue
                 except (ray_tpu.exceptions.RayActorError,
                         ray_tpu.exceptions.WorkerCrashedError):
-                    # Conclusive: the replica process is gone. Replace it
-                    # NOW — miss-counting is only for slow replicas.
-                    dead = True
+                    # Replica-process death. One error can be a transient
+                    # routing artifact (e.g. a probe rerouted while the
+                    # actor was still registering), so replace only after
+                    # two CONSECUTIVE death results — still ~2 cycles,
+                    # not 30 miss counts.
+                    self._dead_counts[key] = \
+                        self._dead_counts.get(key, 0) + 1
+                    dead = self._dead_counts[key] >= 2
                 except Exception:
                     pass
             # Missed probe: a busy replica (long user request) also misses —
@@ -162,6 +169,7 @@ class ServeController:
             self._miss_counts[key] = self._miss_counts.get(key, 0) + 1
             if dead or self._miss_counts[key] >= _MAX_PROBE_MISSES:
                 self._miss_counts.pop(key, None)
+                self._dead_counts.pop(key, None)
                 with self._lock:
                     if r in st.replicas:
                         st.replicas.remove(r)

@@ -17,13 +17,30 @@ _REPLICA_CACHE_TTL_S = 1.0
 
 
 class DeploymentResponse:
-    def __init__(self, ref):
+    def __init__(self, ref, resubmit=None):
         self._ref = ref
+        self._resubmit = resubmit
 
     def result(self, timeout: Optional[float] = None):
+        """Block for the response. If the serving replica died
+        (controller replacement, node loss), the request is resubmitted to
+        a live replica up to 3 times (reference: the serve router requeues
+        requests from dead replicas — at-least-once on replica death).
+        """
         import ray_tpu
+        from ray_tpu import exceptions
 
-        return ray_tpu.get(self._ref, timeout=timeout)
+        attempts = 3
+        while True:
+            try:
+                return ray_tpu.get(self._ref, timeout=timeout)
+            except (exceptions.RayActorError,
+                    exceptions.WorkerCrashedError):
+                if self._resubmit is None or attempts <= 0:
+                    raise
+                attempts -= 1
+                time.sleep(0.2)
+                self._ref = self._resubmit()
 
     @property
     def ref(self):
@@ -95,10 +112,17 @@ class DeploymentHandle:
         except Exception:
             return a
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def _submit(self, method: str, args, kwargs, fresh: bool = False):
+        if fresh:
+            self._refresh(force=True)
         replica = self._pick()
-        ref = replica.handle_request.remote(self._method, args, kwargs)
-        return DeploymentResponse(ref)
+        return replica.handle_request.remote(method, args, kwargs)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        ref = self._submit(self._method, args, kwargs)
+        return DeploymentResponse(
+            ref, resubmit=lambda: self._submit(self._method, args, kwargs,
+                                               fresh=True))
 
 
 class _MethodCaller:
@@ -107,6 +131,7 @@ class _MethodCaller:
         self._method = method
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        replica = self._handle._pick()
-        ref = replica.handle_request.remote(self._method, args, kwargs)
-        return DeploymentResponse(ref)
+        ref = self._handle._submit(self._method, args, kwargs)
+        return DeploymentResponse(
+            ref, resubmit=lambda: self._handle._submit(
+                self._method, args, kwargs, fresh=True))
