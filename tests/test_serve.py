@@ -139,16 +139,24 @@ def test_replica_failure_recovery(serve_cluster):
         pass
     # controller reconciles a fresh replica
     deadline = time.time() + 40
+    errors = []
     while time.time() < deadline:
         try:
             h = serve.get_deployment_handle("fragile")
             if h.remote(None).result(timeout=10) == "alive":
                 break
-        except Exception:
-            pass
+        except Exception as e:
+            errors.append(f"{type(e).__name__}: {e}")
         time.sleep(0.5)
     else:
-        raise AssertionError("replica never recovered")
+        import ray_tpu as _rt
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        ctrl = _rt.get_actor(CONTROLLER_NAME)
+        nrep = len(_rt.get(ctrl.get_replicas.remote("fragile")))
+        raise AssertionError(
+            f"replica never recovered; replicas={nrep}, "
+            f"last errors={errors[-3:]}")
 
 
 def test_autoscaler_smoothing_ignores_single_spike():
