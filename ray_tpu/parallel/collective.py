@@ -335,15 +335,38 @@ class StoreGroup(BaseGroup):
                             f"timed out waiting for rank 0")
                     time.sleep(0.05)
 
-    def _exchange(self, tag: str, value) -> List[Any]:
-        import ray_tpu
+    # Every coordinator round-trip is bounded and retried: a single lost
+    # RPC (e.g. a submission dropped in an ack/re-park race) must degrade
+    # to one extra poll, not hang the collective — an unbounded get() on
+    # one lost call would stall the rank forever.
+    _POLL_RPC_TIMEOUT_S = 10.0
 
+    def _coord_call(self, fut_factory, deadline: float, tag: str):
+        import ray_tpu
+        from ray_tpu import exceptions
+
+        while True:
+            left = deadline - time.time()
+            if left <= 0:
+                raise TimeoutError(f"collective op {tag} timed out")
+            try:
+                return ray_tpu.get(fut_factory(),
+                                   timeout=min(self._POLL_RPC_TIMEOUT_S,
+                                               left))
+            except exceptions.GetTimeoutError:
+                continue
+
+    def _exchange(self, tag: str, value) -> List[Any]:
         self._seq += 1
         key = f"{tag}:{self._seq}"
-        ray_tpu.get(self._coord.contribute.remote(key, self.rank, value))
         deadline = time.time() + 300.0
+        self._coord_call(
+            lambda: self._coord.contribute.remote(key, self.rank, value),
+            deadline, tag)
         while True:
-            vals = ray_tpu.get(self._coord.collect.remote(key, self.rank))
+            vals = self._coord_call(
+                lambda: self._coord.collect.remote(key, self.rank),
+                deadline, tag)
             if vals is not None:
                 return vals
             if time.time() > deadline:
@@ -391,24 +414,24 @@ class StoreGroup(BaseGroup):
         self._exchange("barrier", None)
 
     def send(self, tensor, dst_rank: int):
-        import ray_tpu
-
         chan = (self.rank, dst_rank)
         seq = self._p2p_seq.get(chan, 0) + 1
         self._p2p_seq[chan] = seq
         key = f"p2p:{self.rank}->{dst_rank}:{seq}"
-        ray_tpu.get(self._coord.post.remote(key, np.asarray(tensor)))
+        payload = np.asarray(tensor)
+        self._coord_call(
+            lambda: self._coord.post.remote(key, payload),
+            time.time() + 300.0, "send")
 
     def recv(self, shape, dtype, src_rank: int):
-        import ray_tpu
-
         chan = (src_rank, self.rank)
         seq = self._p2p_seq.get(chan, 0) + 1
         self._p2p_seq[chan] = seq
         key = f"p2p:{src_rank}->{self.rank}:{seq}"
         deadline = time.time() + 300.0
         while True:
-            val = ray_tpu.get(self._coord.take.remote(key))
+            val = self._coord_call(
+                lambda: self._coord.take.remote(key), deadline, "recv")
             if val is not None:
                 return np.asarray(val, dtype=dtype).reshape(shape)
             if time.time() > deadline:
