@@ -1481,6 +1481,18 @@ class GcsServer:
                 }
             conn.reply(msg_id, out)
 
+    def _h_dump_stacks(self, conn, p, msg_id):
+        """Fan a stack-dump request out to every node (reference: the
+        `ray stack` CLI, scripts.py; dumps surface via the log stream)."""
+        with self._lock:
+            nodes = [n for n in self._nodes.values() if n.alive]
+        for n in nodes:
+            try:
+                n.conn.notify("dump_stacks")
+            except Exception:
+                pass
+        conn.reply(msg_id, len(nodes))
+
     # --------------------------------------------------------------- pubsub
 
     def _h_subscribe(self, conn, p, msg_id):

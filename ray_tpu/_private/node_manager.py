@@ -632,6 +632,18 @@ class NodeManager:
                     self.store.delete(oid)
             elif mtype == "submit_actor_task":
                 self._on_submit_actor_task(payload)
+            elif mtype == "dump_stacks":
+                # SIGUSR2 -> worker_main's faulthandler prints every
+                # thread's stack to stderr -> per-worker log file -> log
+                # stream (reference: `ray stack`).
+                with self._lock:
+                    pids = [w.proc.pid for w in self._workers.values()
+                            if w.proc.poll() is None]
+                for pid in pids:
+                    try:
+                        os.kill(pid, signal.SIGUSR2)
+                    except OSError:
+                        pass
             elif mtype == "shutdown":
                 threading.Thread(target=self.shutdown, daemon=True).start()
         except Exception:

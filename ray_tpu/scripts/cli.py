@@ -195,6 +195,29 @@ def cmd_down(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """Dump every worker's Python stacks cluster-wide (reference:
+    ``ray stack``) — dumps arrive through the worker log stream."""
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    addr = args.address or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr and os.path.exists(_ADDR_FILE):
+        addr = open(_ADDR_FILE).read().strip()
+    if not addr:
+        print("no cluster address: pass --address or set RAY_TPU_ADDRESS",
+              file=sys.stderr)
+        return 1
+    ray_tpu.init(address=addr, log_to_driver=True)
+    try:
+        n = worker_mod.require_worker().gcs.request("dump_stacks", {})
+        print(f"requested stack dumps from {n} node(s); collecting...")
+        time.sleep(3.0)  # dumps stream in via driver_logs
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
 def cmd_stop(args) -> int:
     if not os.path.exists(_PID_FILE):
         print("no head running")
@@ -295,6 +318,10 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("down")
     p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("stack")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("status")
     p.add_argument("--address", default=None)

@@ -1,0 +1,152 @@
+"""Distributed FIFO queue (reference: ``ray.util.queue.Queue`` —
+``python/ray/util/queue.py``; an actor-backed queue usable from any
+task/actor/driver).
+
+    from ray_tpu.util.queue import Queue
+    q = Queue(maxsize=100)
+    q.put(item)             # blocks while full
+    item = q.get(timeout=5) # blocks until an item arrives
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import collections
+
+        self.maxsize = maxsize
+        self._items: collections.deque = collections.deque()
+
+    def put(self, item) -> bool:
+        """True if accepted; False while full (caller polls)."""
+        if self.maxsize > 0 and len(self._items) >= self.maxsize:
+            return False
+        self._items.append(item)
+        return True
+
+    def put_batch(self, items: List[Any]) -> int:
+        n = 0
+        for it in items:
+            if self.maxsize > 0 and len(self._items) >= self.maxsize:
+                break
+            self._items.append(it)
+            n += 1
+        return n
+
+    def get(self, n: int = 1):
+        """Up to n items (empty list while empty; caller polls)."""
+        out = []
+        while self._items and len(out) < n:
+            out.append(self._items.popleft())
+        return out
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and len(self._items) >= self.maxsize
+
+
+class Queue:
+    """Client handle; construct once and pass freely between tasks and
+    actors (the handle pickles; all state lives in the backing actor)."""
+
+    _POLL_S = 0.01
+
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict]
+                 = None):
+        import ray_tpu
+
+        cls = ray_tpu.remote(_QueueActor)
+        if actor_options:
+            cls = cls.options(**actor_options)
+        self._actor = cls.remote(maxsize)
+        self.maxsize = maxsize
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        deadline = time.time() + timeout if timeout is not None else None
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item), timeout=30):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.time() > deadline:
+                raise Full()
+            time.sleep(self._POLL_S)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        import ray_tpu
+
+        deadline = time.time() + timeout if timeout is not None else None
+        while True:
+            items = ray_tpu.get(self._actor.get.remote(1), timeout=30)
+            if items:
+                return items[0]
+            if not block:
+                raise Empty()
+            if deadline is not None and time.time() > deadline:
+                raise Empty()
+            time.sleep(self._POLL_S)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        import ray_tpu
+
+        n = ray_tpu.get(self._actor.put_batch.remote(list(items)),
+                        timeout=30)
+        if n < len(items):
+            raise Full(f"accepted {n}/{len(items)} items")
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        import ray_tpu
+
+        items = ray_tpu.get(self._actor.get.remote(num_items), timeout=30)
+        if len(items) < num_items:
+            raise Empty(f"only {len(items)}/{num_items} items available")
+        return items
+
+    def qsize(self) -> int:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.qsize.remote(), timeout=30)
+
+    def empty(self) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.empty.remote(), timeout=30)
+
+    def full(self) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.full.remote(), timeout=30)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:
+            pass

@@ -90,3 +90,38 @@ def test_cli_head_lifecycle(tmp_path):
     finally:
         out = run("stop")
         assert out.returncode == 0
+
+
+def test_dump_stacks_reaches_worker_logs(capfd):
+    """`ray_tpu stack` plumbing: dump_stacks fans SIGUSR2 to workers and
+    the faulthandler tracebacks stream back through worker logs."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    ray_tpu.init(num_cpus=2, log_to_driver=True)
+    try:
+        @ray_tpu.remote
+        class Sleeper:
+            def nap(self, s):
+                _time.sleep(s)
+                return True
+
+        s = Sleeper.remote()
+        ref = s.nap.remote(5)
+        _time.sleep(0.5)  # actor mid-nap
+        n = worker_mod.require_worker().gcs.request("dump_stacks", {})
+        assert n >= 1
+        deadline = _time.time() + 15
+        buf = ""
+        while _time.time() < deadline:
+            out, err = capfd.readouterr()
+            buf += out + err
+            if "Current thread" in buf or "Thread 0x" in buf:
+                break
+            _time.sleep(0.3)
+        assert "Thread" in buf, buf[-400:]
+        assert ray_tpu.get(ref, timeout=30)
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,75 @@
+"""Actor-backed distributed Queue (reference: ray.util.queue.Queue)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture
+def ray_2cpu():
+    ctx = ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_fifo_roundtrip(ray_2cpu):
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get(timeout=10) for _ in range(5)] == list(range(5))
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+
+def test_maxsize_blocks_and_full(ray_2cpu):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    with pytest.raises(Full):
+        q.put(3, timeout=0.3)
+
+    def drain_later():
+        time.sleep(0.5)
+        q.get(timeout=10)
+
+    t = threading.Thread(target=drain_later)
+    t.start()
+    q.put(3, timeout=10)  # unblocks once the drainer makes room
+    t.join()
+    assert q.qsize() == 2
+
+
+def test_queue_across_tasks(ray_2cpu):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i * 10)
+        return True
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    p = producer.remote(q, 4)
+    out = ray_tpu.get(consumer.remote(q, 4), timeout=60)
+    assert ray_tpu.get(p, timeout=30)
+    assert out == [0, 10, 20, 30]
+
+
+def test_batch_ops(ray_2cpu):
+    q = Queue()
+    q.put_nowait_batch([1, 2, 3, 4])
+    assert q.get_nowait_batch(3) == [1, 2, 3]
+    with pytest.raises(Empty):
+        q.get_nowait_batch(5)
