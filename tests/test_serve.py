@@ -154,9 +154,24 @@ def test_replica_failure_recovery(serve_cluster):
 
         ctrl = _rt.get_actor(CONTROLLER_NAME)
         nrep = len(_rt.get(ctrl.get_replicas.remote("fragile")))
+        # Pull the newest worker stderr tails: if replacements are crash-
+        # looping, the crash reason is in there.
+        import glob
+        import os as _os
+
+        from ray_tpu._private import worker as worker_mod
+
+        tails = []
+        sess = worker_mod._global_cluster.session_dir
+        errs = sorted(glob.glob(_os.path.join(sess, "logs", "*.err")),
+                      key=_os.path.getmtime)[-4:]
+        for f in errs:
+            with open(f) as fh:
+                tails.append(f"--- {_os.path.basename(f)} ---\n"
+                             + fh.read()[-1500:])
         raise AssertionError(
             f"replica never recovered; replicas={nrep}, "
-            f"last errors={errors[-3:]}")
+            f"last errors={errors[-3:]}\n" + "\n".join(tails))
 
 
 def test_autoscaler_smoothing_ignores_single_spike():
