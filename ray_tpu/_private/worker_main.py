@@ -437,8 +437,12 @@ def main():
             job_id=JobID.from_int(0),
             client_id=f"worker-{worker_id.hex()[:12]}",
         )
-    except (ConnectionError, OSError):
-        # cluster is already gone (shutdown race); exit quietly
+    except (ConnectionError, OSError) as e:
+        # Cluster already gone (shutdown race) — usually benign, but say
+        # WHY on stderr (-> worker log) so a connect/attach crash loop is
+        # diagnosable instead of silent.
+        print(f"worker startup aborted: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
         sys.exit(0)
     set_global_worker(core)
     executor = WorkerExecutor(core, nm_address, worker_id)
