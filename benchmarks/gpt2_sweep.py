@@ -1,0 +1,98 @@
+"""GPT-2 125M single-chip training sweep: batch x remat policy x
+attention backend. Prints one JSON line per config (chained-dispatch
+timing, one sync per measurement window — robust to tunnel RTT).
+
+Usage: python benchmarks/gpt2_sweep.py [--quick]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--seq", type=int, default=1024)
+    args = ap.parse_args()
+
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import (
+        GPTConfig, count_params, make_train_state, make_train_step,
+    )
+
+    def peak():
+        kind = (jax.devices()[0].device_kind or "").lower()
+        for k, v in {"v5e": 197e12, "v4": 275e12, "v5p": 459e12,
+                     "v6e": 918e12}.items():
+            if k in kind:
+                return v
+        return 197e12
+
+    def run(batch, chain=8, **ov):
+        try:
+            cfg = GPTConfig.preset("gpt2-125m", max_seq=args.seq, **ov)
+            opt = optax.adamw(3e-4, weight_decay=0.1)
+            state = make_train_state(jax.random.key(0), cfg, opt)
+            step = jax.jit(make_train_step(cfg, opt), donate_argnums=0)
+            rng = np.random.default_rng(0)
+            toks = jnp.asarray(rng.integers(
+                0, cfg.vocab_size, (batch, args.seq + 1)), jnp.int32)
+            data = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+            t0 = time.perf_counter()
+            step = step.lower(state, data).compile()
+            compile_s = round(time.perf_counter() - t0, 1)
+            for _ in range(2):
+                state, m = step(state, data)
+            float(jax.device_get(m["loss"]))
+            t0 = time.perf_counter()
+            for _ in range(chain):
+                state, m = step(state, data)
+            float(jax.device_get(m["loss"]))
+            dt = (time.perf_counter() - t0) / chain
+            n = count_params(state.params)
+            tps = batch * args.seq / dt
+            print(json.dumps({
+                "batch": batch, "overrides": {k: str(v)
+                                              for k, v in ov.items()},
+                "step_ms": round(dt * 1e3, 1),
+                "tokens_per_sec": round(tps, 0),
+                "mfu": round(tps * 6 * n / peak(), 4),
+                "compile_s": compile_s,
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "batch": batch, "overrides": {k: str(v)
+                                              for k, v in ov.items()},
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+            }), flush=True)
+
+    # XLA fused attention (the seq-1024 winner) across batch + remat.
+    run(32, flash_attention=False)
+    run(32, flash_attention=False, remat_policy="matmuls")
+    if not args.quick:
+        run(48, flash_attention=False)
+        run(48, flash_attention=False, remat_policy="matmuls")
+        run(64, flash_attention=False, remat_policy="matmuls")
+        # Pallas flash for reference at this length.
+        run(32, flash_attention=True)
+
+
+if __name__ == "__main__":
+    main()
