@@ -1364,6 +1364,36 @@ class GcsServer:
         alive = [n for n in self._nodes.values() if n.alive]
         if not alive:
             return False
+        # TPU topology awareness (SURVEY hard part (f): a gang's bundles
+        # must map onto ONE ICI island — cross-slice collectives fall off
+        # ICI onto DCN). When every bundle wants TPU and nodes carry a
+        # "slice" label, try slice-local placement first: attempt the
+        # whole PG inside each slice (least-loaded slice first) and only
+        # then fall back to the topology-blind node set.
+        wants_tpu = all(b.resources.get("TPU", 0) > 0 for b in spec.bundles) \
+            and bool(spec.bundles)
+        slices: Dict[str, list] = {}
+        for n in alive:
+            sl = n.labels.get("slice")
+            if sl:
+                slices.setdefault(sl, []).append(n)
+        if wants_tpu and slices and len(slices) > 1:
+            def slice_load(nodes):
+                return sum(n.available.utilization(n.total)
+                           for n in nodes) / len(nodes)
+
+            for _, members in sorted(slices.items(),
+                                     key=lambda kv: slice_load(kv[1])):
+                if self._place_pg_on(entry, members):
+                    return True
+            # fall through: try all nodes (single-slice PGs that don't fit
+            # one slice stay PENDING via the normal path below)
+        return self._place_pg_on(entry, alive)
+
+    def _place_pg_on(self, entry: PgEntry, alive: list) -> bool:
+        spec = entry.spec
+        if not alive:
+            return False
         # Work on copies of availability for atomicity.
         avail = {n.node_id: ResourceSet(n.available.to_dict()) for n in alive}
         placement: Dict[int, str] = {}

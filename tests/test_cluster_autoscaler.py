@@ -119,3 +119,39 @@ def test_min_workers_launched(cluster):
     summary = scaler.run_once()
     assert summary["launched"] == 2
     assert len(provider.non_terminated_nodes()) == 2
+
+
+def test_pg_prefers_single_slice_for_tpu_bundles():
+    """TPU placement groups pack onto one ICI slice: bundles must not
+    straddle slice labels when a single slice can host the gang
+    (SURVEY hard part (f))."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import placement_group
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    # Two 2-host slices, each host with 4 chips.
+    nodes = {}
+    for sl in ("slice-a", "slice-b"):
+        for h in range(2):
+            nm = cluster.add_node(num_cpus=2, num_tpus=4,
+                                  labels={"slice": sl})
+            nodes[nm.node_id] = sl
+    cluster.connect(object_store_memory=64 * 1024 * 1024)
+    cluster.wait_for_nodes()
+    try:
+        # 2 bundles x 4 TPU: exactly one slice's worth, spread over hosts.
+        pg = placement_group([{"TPU": 4, "CPU": 1}] * 2,
+                             strategy="STRICT_SPREAD")
+        assert pg.wait(timeout_seconds=30)
+        from ray_tpu._private import worker as worker_mod
+
+        table = worker_mod.require_worker().gcs.request("pg_table", {})
+        bundles = table[pg.id.binary()]["bundles"]
+        placed_slices = {nodes[b["node_id"]] for b in bundles}
+        assert len(placed_slices) == 1, (
+            f"gang straddles slices: {placed_slices}")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
