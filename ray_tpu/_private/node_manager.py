@@ -118,6 +118,15 @@ class NodeManager:
 
         # Client connection to the GCS.
         self._labels = labels or {}
+        # Auto-label the node with its ICI slice identity so the PG
+        # scheduler can keep gangs slice-local (TPU pods expose the slice
+        # via MEGASCALE_SLICE_ID; single-slice setups via tpu_topology).
+        if "slice" not in self._labels:
+            slice_id = os.environ.get("MEGASCALE_SLICE_ID") or \
+                os.environ.get("TPU_SLICE_ID") or \
+                (config.tpu_topology or None)
+            if slice_id and num_tpus:
+                self._labels["slice"] = str(slice_id)
         self._is_head = is_head
         self._node_name = node_name
         self.gcs = protocol.connect(gcs_address, handler=self._handle_gcs,
