@@ -138,6 +138,9 @@ _d("free_grace_s", 1.0,
    "Seconds a zero-ref object is kept before its locations are freed "
    "(absorbs in-flight borrower registrations, e.g. a ref pickled to "
    "another process whose incref hasn't landed yet).")
+_d("max_lineage_entries", 10000,
+   "Task specs retained for lineage reconstruction (LRU-evicted beyond "
+   "this; reconstructing evicted lineage fails cleanly as ObjectLost).")
 _d("max_lineage_reconstructions", 3,
    "Times a lost object may be rebuilt by re-running its producing task "
    "(reference: object_recovery_manager.h:41 + task_manager resubmit).")

@@ -664,8 +664,20 @@ class GcsServer:
             for rid in spec.return_ids():
                 self._producing_task[rid.binary()] = spec.task_id.binary()
             # Retain the spec for lineage reconstruction; pin its args so
-            # refcount-zero deps can't be freed out from under it.
+            # refcount-zero deps can't be freed out from under it. The
+            # table is LRU-bounded: evicting old lineage turns a later
+            # reconstruction attempt into a clean ObjectLost error
+            # (reference: lineage eviction once refs go out of scope).
+            from ray_tpu._private.config import config as _cfg
+
             self._task_specs[spec.task_id.binary()] = spec
+            cap = int(_cfg.max_lineage_entries)
+            while len(self._task_specs) > cap:
+                old_tid, old_spec = next(iter(self._task_specs.items()))
+                del self._task_specs[old_tid]
+                self._reconstructions.pop(old_tid, None)
+                for rid in old_spec.return_ids():
+                    self._producing_task.pop(rid.binary(), None)
             self._pin_task_args(spec)
             self._enqueue_task(spec)
             self._try_schedule()
@@ -788,6 +800,10 @@ class GcsServer:
                             break  # this actor can't place now
                     continue
                 if spec.task_id.binary() in self._cancelled_tasks:
+                    # e.g. a retry re-enqueued after a force-cancel: fail
+                    # its returns and release its arg pins instead of
+                    # silently dropping (pins would leak forever).
+                    self._fail_task_objects(spec, "cancelled")
                     continue
                 if spec.placement_group_id is not None:
                     node = self._node_for_pg_task(spec)
@@ -859,20 +875,25 @@ class GcsServer:
         tid = p["task_id"]
         with self._lock:
             self._cancelled_tasks.add(tid)
-            # remove from queues
+            # Capture the spec BEFORE removing it from the queues — the
+            # not-running branch below must fail its return objects, and
+            # a removed spec can no longer be found.
+            spec = self._spec_for_task(tid)
             self._queued_tasks.remove_task(tid)
             for lst in self._waiting_tasks.values():
                 lst[:] = [s for s in lst if s.task_id.binary() != tid]
             running = self._running_tasks.get(tid)
             if running is not None:
-                spec, node_id = running
+                rspec, node_id = running
                 node = self._nodes.get(node_id)
                 if node is not None:
                     node.conn.notify("cancel_task", {
                         "task_id": tid, "force": p.get("force", False)})
             else:
-                # Cancelled before dispatch: fail its return objects.
-                spec = self._spec_for_task(tid)
+                # Cancelled before dispatch: fail its return objects
+                # (also releases its arg pins via _fail_task_objects).
+                if spec is None:
+                    spec = self._task_specs.get(tid)
                 if spec is not None:
                     self._fail_task_objects(spec, "cancelled")
         conn.reply(msg_id, True)
@@ -881,6 +902,10 @@ class GcsServer:
         for s in self._queued_tasks:
             if s.task_id.binary() == tid:
                 return s
+        for lst in self._waiting_tasks.values():
+            for s in lst:
+                if s.task_id.binary() == tid:
+                    return s
         return None
 
     # ------------------------------------------------------------- objects

@@ -334,8 +334,7 @@ class NodeManager:
             self.oom_kills += 1
             try:
                 self.gcs.notify("task_events", [{
-                    "task_id": tid.hex() if hasattr(tid, "hex") else
-                    tid.hex(),
+                    "task_id": tid.hex(),
                     "name": getattr(spec, "name",
                                     getattr(spec, "method_name", "")),
                     "kind": "task", "node_id": self.node_id,
@@ -750,9 +749,24 @@ class NodeManager:
             self._report_task_done(spec.task_id.binary(), "error",
                                    objs, error=str(e))
             return
+        # TPU requests get their chip assignment exactly like the plain
+        # TPU lease path — a runtime_env must not strip TPU_VISIBLE_CHIPS
+        # or desync the chip free-list from GCS accounting.
+        chips: List[int] = []
+        k = int(spec.resources.get(TPU, 0))
+        if k > 0:
+            with self._lock:
+                free = sorted(self._free_tpu_chips)[:k]
+                if len(free) < k:
+                    self._task_queue.append(spec)
+                    return
+                for c in free:
+                    self._free_tpu_chips.discard(c)
+                chips = free
         env = dict((spec.runtime_env or {}).get("env_vars", {}))
         w = self._spawn_worker(dedicated=True, env_extra=env, cwd=cwd,
-                               extra_pythonpath=pypaths)
+                               extra_pythonpath=pypaths,
+                               tpu_chips=chips or None)
         with self._lock:
             w.pending_pushes.append(("run_task", spec))
             w.current_tasks[spec.task_id.binary()] = spec

@@ -23,6 +23,34 @@ URI_SCHEME = "kvzip://"
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_PACKAGE_BYTES = 256 * 1024 * 1024
 
+# Per-process upload memo: path -> (tree stamp, uri). Submitting N tasks
+# with the same working_dir zips it once, not N times (reference:
+# packaging.py upload cache keyed by package URI).
+_upload_memo: Dict[str, Tuple[Any, str]] = {}
+
+
+def _tree_stamp(path: str):
+    """Cheap change detector: (count, total size, max mtime_ns) over the
+    walked tree — no file contents read."""
+    path = os.path.abspath(path)
+    if os.path.isfile(path):
+        st = os.stat(path)
+        return (1, st.st_size, st.st_mtime_ns)
+    n = size = latest = 0
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for f in files:
+            if f.endswith(".pyc"):
+                continue
+            try:
+                st = os.stat(os.path.join(root, f))
+            except OSError:
+                continue
+            n += 1
+            size += st.st_size
+            latest = max(latest, st.st_mtime_ns)
+    return (n, size, latest)
+
 
 def _zip_path(path: str) -> bytes:
     """Deterministic zip of a directory (or single file) — stable entry
@@ -96,12 +124,23 @@ def package_runtime_env(kv, runtime_env: Optional[Dict[str, Any]]
     if not runtime_env:
         return runtime_env
     env = dict(runtime_env)
+
+    def cached_upload(path: str, zipper) -> str:
+        key = os.path.abspath(path)
+        stamp = _tree_stamp(key)
+        memo = _upload_memo.get(key)
+        if memo is not None and memo[0] == stamp:
+            return memo[1]
+        uri = _upload(kv, zipper(path))
+        _upload_memo[key] = (stamp, uri)
+        return uri
+
     wd = env.get("working_dir")
     if wd and not wd.startswith(URI_SCHEME):
         if not os.path.isdir(wd):
             raise ValueError(f"runtime_env working_dir {wd!r} is not a "
                              f"directory")
-        env["working_dir"] = _upload(kv, _zip_path(wd))
+        env["working_dir"] = cached_upload(wd, _zip_path)
     mods = env.get("py_modules")
     if mods:
         out: List[str] = []
@@ -111,7 +150,7 @@ def package_runtime_env(kv, runtime_env: Optional[Dict[str, Any]]
                 continue
             if not os.path.exists(m):
                 raise ValueError(f"runtime_env py_module {m!r} not found")
-            out.append(_upload(kv, _module_zip(m)))
+            out.append(cached_upload(m, _module_zip))
         env["py_modules"] = out
     return env
 

@@ -272,34 +272,62 @@ class _Coordinator:
     actor's serial execution loop never stalls.
     """
 
+    # Completed slots / delivered mail are kept in bounded caches so a
+    # RETRIED collect/take (client-side get timeout after the first call
+    # already executed) returns the same result instead of None — every
+    # coordinator op is idempotent, which is what lets clients use
+    # bounded, retried RPCs without losing data.
+    _DONE_CACHE = 256
+
     def __init__(self, world_size: int):
+        import collections
+
         self.world_size = world_size
         self._slots: Dict[str, dict] = {}
         self._mail: Dict[str, Any] = {}
+        self._done_slots: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._delivered: "collections.OrderedDict" = \
+            collections.OrderedDict()
+
+    @staticmethod
+    def _cache_put(cache, key, value, cap):
+        cache[key] = value
+        while len(cache) > cap:
+            cache.popitem(last=False)
 
     def contribute(self, key: str, rank: int, value):
         slot = self._slots.setdefault(key, {"vals": {}, "taken": set()})
-        slot["vals"][rank] = value
+        slot["vals"][rank] = value  # idempotent: same rank overwrites
         return len(slot["vals"])
 
     def collect(self, key: str, rank: int):
-        """Return all contributions once complete; the slot is freed after
-        every rank has collected (prevents unbounded growth in long loops)."""
+        """Return all contributions once complete; the slot moves to a
+        bounded done-cache after every rank collected, so late retries
+        still see the result."""
         slot = self._slots.get(key)
-        if slot is None or len(slot["vals"]) < self.world_size:
+        if slot is None:
+            done = self._done_slots.get(key)
+            return done  # None while incomplete; cached vals if finished
+        if len(slot["vals"]) < self.world_size:
             return None
         vals = [slot["vals"][r] for r in range(self.world_size)]
         slot["taken"].add(rank)
         if len(slot["taken"]) >= self.world_size:
             self._slots.pop(key, None)
+            self._cache_put(self._done_slots, key, vals, self._DONE_CACHE)
         return vals
 
     def post(self, key: str, value):
-        self._mail[key] = value
+        self._mail[key] = value  # idempotent
         return True
 
     def take(self, key: str):
-        return self._mail.pop(key, None)
+        val = self._mail.pop(key, None)
+        if val is not None:
+            self._cache_put(self._delivered, key, val, self._DONE_CACHE)
+            return val
+        return self._delivered.get(key)  # retried take after delivery
 
 
 class StoreGroup(BaseGroup):
@@ -345,15 +373,24 @@ class StoreGroup(BaseGroup):
         import ray_tpu
         from ray_tpu import exceptions
 
+        ref = fut_factory()
+        stale = 0
         while True:
             left = deadline - time.time()
             if left <= 0:
                 raise TimeoutError(f"collective op {tag} timed out")
             try:
-                return ray_tpu.get(fut_factory(),
-                                   timeout=min(self._POLL_RPC_TIMEOUT_S,
-                                               left))
+                return ray_tpu.get(
+                    ref, timeout=min(self._POLL_RPC_TIMEOUT_S, left))
             except exceptions.GetTimeoutError:
+                # Keep waiting on the SAME call first; after a few windows
+                # assume the submission was lost and resubmit — safe
+                # because every coordinator op is idempotent (retried
+                # collect/take return cached results).
+                stale += 1
+                if stale >= 3:
+                    stale = 0
+                    ref = fut_factory()
                 continue
 
     def _exchange(self, tag: str, value) -> List[Any]:
