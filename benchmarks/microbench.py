@@ -1,0 +1,114 @@
+"""Control-plane microbenchmarks (reference:
+``python/ray/_private/ray_perf.py:93-244`` — the release microbenchmark
+suite: put/get calls/s, task throughput, actor call rates).
+
+Prints one JSON line per metric. Run: python benchmarks/microbench.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(name, fn, n, unit="ops/s"):
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": name, "value": round(n / dt, 1),
+                      "unit": unit, "n": n,
+                      "total_s": round(dt, 3)}), flush=True)
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=1024 * 1024 * 1024)
+    try:
+        # ---- plasma put/get, small objects
+        def put_small(n):
+            for i in range(n):
+                ray_tpu.put(i)
+
+        timed("put_calls_per_s_small", put_small, 2000)
+
+        refs = [ray_tpu.put(i) for i in range(2000)]
+
+        def get_small(n):
+            for r in refs[:n]:
+                ray_tpu.get(r)
+
+        timed("get_calls_per_s_small", get_small, 2000)
+
+        # ---- put GB/s, large objects
+        blob = np.ones(64 << 20, np.uint8)  # 64 MiB
+
+        def put_large(n):
+            for _ in range(n):
+                ray_tpu.put(blob)
+
+        # Keep total put volume under the spill threshold (0.8 x store)
+        # so this measures serialization+arena copy, not disk spill.
+        t0 = time.perf_counter()
+        put_large(6)
+        dt = time.perf_counter() - t0
+        print(json.dumps({"metric": "single_client_put_gb_s",
+                          "value": round(6 * 64 / 1024 / dt, 3),
+                          "unit": "GB/s"}), flush=True)
+
+        # ---- tasks: sync round-trips and async pipelined
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        def tasks_sync(n):
+            for _ in range(n):
+                ray_tpu.get(nop.remote())
+
+        timed("tasks_sync_per_s", tasks_sync, 300)
+
+        def tasks_async(n):
+            ray_tpu.get([nop.remote() for _ in range(n)])
+
+        timed("tasks_async_per_s", tasks_async, 2000)
+
+        # ---- actor calls: 1:1 sync and pipelined
+        @ray_tpu.remote
+        class A:
+            def nop(self):
+                return None
+
+        a = A.remote()
+        ray_tpu.get(a.nop.remote())
+
+        def actor_sync(n):
+            for _ in range(n):
+                ray_tpu.get(a.nop.remote())
+
+        timed("actor_calls_sync_per_s", actor_sync, 500)
+
+        def actor_async(n):
+            ray_tpu.get([a.nop.remote() for _ in range(n)])
+
+        timed("actor_calls_async_per_s", actor_async, 3000)
+
+        # ---- n:n actor throughput
+        actors = [A.remote() for _ in range(4)]
+        ray_tpu.get([x.nop.remote() for x in actors])
+
+        def actor_nn(n):
+            per = n // len(actors)
+            ray_tpu.get([x.nop.remote() for x in actors
+                         for _ in range(per)])
+
+        timed("actor_calls_nn_per_s", actor_nn, 4000)
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
