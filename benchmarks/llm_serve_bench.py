@@ -1,0 +1,116 @@
+"""BASELINE config 5: LLM inference deployment with autoscaled
+replicas — a llama-style decoder served through ray_tpu.serve, driven
+with concurrent requests until queue-depth autoscaling adds replicas.
+
+On TPU hosts each replica pins chips via ray_actor_options
+{"num_tpus": N}; this harness runs the "llama-tiny" preset so it also
+executes on the CPU test platform.
+
+Prints JSON lines: per-phase tokens/s and the replica count trajectory.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--new-tokens", type=int, default=16)
+    ap.add_argument("--clients", type=int, default=6)
+    ap.add_argument("--requests-per-client", type=int, default=4)
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
+    serve.start()
+    try:
+        new_tokens = args.new_tokens
+
+        @serve.deployment(
+            name="llm",
+            autoscaling_config=AutoscalingConfig(
+                min_replicas=1, max_replicas=3,
+                target_ongoing_requests=1.0,
+                upscale_delay_s=0.2, look_back_period_s=1.0),
+        )
+        class LLM:
+            def __init__(self):
+                import jax
+                import numpy as np
+
+                from ray_tpu.models import GPTConfig, init_params
+                from ray_tpu.models.generate import generate
+
+                self.cfg = GPTConfig.preset("llama-tiny", n_layers=2,
+                                            max_seq=128)
+                self.params = init_params(jax.random.key(0), self.cfg)
+                self._generate = generate
+                self._jax = jax
+                self._np = np
+
+            def __call__(self, req):
+                import jax.numpy as jnp
+
+                prompt = jnp.asarray(
+                    self._np.asarray(req["prompt"], self._np.int32))[None]
+                out = self._generate(
+                    self.params, prompt, self._jax.random.key(0),
+                    cfg=self.cfg, max_new_tokens=req["n"])
+                return {"tokens": self._np.asarray(out)[0].tolist()}
+
+        handle = serve.run(LLM.bind(), route_prefix="/llm")
+        # Warm one request (compiles the decode loop).
+        out = handle.remote({"prompt": [1, 2, 3], "n": new_tokens}).result(
+            timeout=600)
+        assert len(out["tokens"]) >= new_tokens
+
+        results = []
+        lock = threading.Lock()
+
+        def client(cid):
+            for i in range(args.requests_per_client):
+                t0 = time.perf_counter()
+                handle.remote({"prompt": [1 + cid, 2, 3],
+                               "n": new_tokens}).result(timeout=600)
+                with lock:
+                    results.append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(args.clients)]
+        for t in threads:
+            t.start()
+        replica_trajectory = []
+        while any(t.is_alive() for t in threads):
+            replica_trajectory.append(
+                serve.status()["llm"]["num_replicas"])
+            time.sleep(0.5)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        n_req = args.clients * args.requests_per_client
+        print(json.dumps({
+            "metric": "llm_serve_tokens_per_sec",
+            "value": round(n_req * new_tokens / wall, 1),
+            "unit": "tokens/s",
+            "requests": n_req,
+            "p50_latency_s": round(sorted(results)[len(results) // 2], 3),
+            "max_replicas_seen": max(replica_trajectory or [1]),
+            "replica_trajectory": replica_trajectory,
+        }), flush=True)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

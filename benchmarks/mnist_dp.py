@@ -1,0 +1,104 @@
+"""BASELINE config 2: 4-way data-parallel MLP training throughput
+through JaxTrainer (fashion-MNIST-shaped synthetic data — the sandbox
+has no egress, so the dataset is a deterministic stand-in with the same
+shapes: 28x28 grayscale, 10 classes).
+
+Prints one JSON line: samples/sec across the gang.
+Usage: python benchmarks/mnist_dp.py [--workers 4] [--backend store]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _loop(config):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu import train
+    from ray_tpu.models import MLPConfig, mlp_forward, mlp_init
+    from ray_tpu.parallel import collective
+
+    rank, ws = train.get_world_rank(), train.get_world_size()
+    cfg = MLPConfig(in_dim=784, hidden=(256, 128), out_dim=10)
+    params = mlp_init(jax.random.key(0), cfg)
+    g = collective.get_group(
+        train.session._get_session().collective_group_name) if ws > 1 \
+        else None
+
+    rng = np.random.default_rng(1234 + rank)
+    bs = config["batch_size"]
+    x = jnp.asarray(rng.normal(size=(bs, 784)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(bs,)))
+
+    def loss_fn(p):
+        logits = mlp_forward(p, x)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        return jnp.mean(logz - jnp.take_along_axis(
+            logits, y[:, None], axis=1)[:, 0])
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    grad_fn(params)  # compile
+
+    steps = config["steps"]
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, grads = grad_fn(params)
+        if g is not None:
+            flat, treedef = jax.tree.flatten(grads)
+            flat = [jnp.asarray(g.allreduce(np.asarray(t))) / ws
+                    for t in flat]
+            grads = jax.tree.unflatten(treedef, flat)
+        params = jax.tree.map(lambda p, gr: p - 0.1 * gr, params, grads)
+    dt = time.perf_counter() - t0
+    if rank == 0:
+        train.report({"samples_per_sec": steps * bs * ws / dt,
+                      "step_ms": dt / steps * 1e3,
+                      "loss": float(loss)})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--backend", default=None,
+                    help="collective backend (default: trainer default)")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=60)
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ray_tpu.init(num_cpus=args.workers + 1,
+                 object_store_memory=256 * 1024 * 1024)
+    try:
+        kw = {"backend": args.backend} if args.backend else {}
+        trainer = JaxTrainer(
+            _loop,
+            train_loop_config={"batch_size": args.batch_size,
+                               "steps": args.steps},
+            scaling_config=ScalingConfig(num_workers=args.workers),
+            run_config=RunConfig(name="mnist_dp"),
+            **kw)
+        result = trainer.fit()
+        assert result.ok, result.error
+        m = result.metrics_history[-1]
+        print(json.dumps({
+            "metric": "mnist_mlp_dp_samples_per_sec",
+            "value": round(m["samples_per_sec"], 1),
+            "unit": "samples/s",
+            "workers": args.workers,
+            "step_ms": round(m["step_ms"], 2),
+        }), flush=True)
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
