@@ -1105,6 +1105,8 @@ def init(address=None, num_cpus=None, num_tpus=None, resources=None,
             worker.namespace = namespace
         _global_worker = worker
         atexit.register(_atexit_shutdown)
+        from ray_tpu._private import usage
+        usage.on_driver_connect()
         return ClientContext(gcs_address, worker)
 
 
@@ -1119,6 +1121,8 @@ def shutdown():
     global _global_worker, _global_cluster
     with _init_lock:
         if _global_worker is not None:
+            from ray_tpu._private import usage
+            usage.on_driver_disconnect()
             _global_worker.disconnect()
             _global_worker = None
         if _global_cluster is not None:

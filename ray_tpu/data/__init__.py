@@ -32,3 +32,7 @@ __all__ = [
     "from_arrow", "read_text", "read_csv", "read_json", "read_parquet",
     "read_binary_files",
 ]
+
+from ray_tpu._private import usage as _usage  # noqa: E402
+_usage.record_library_usage("data")
+del _usage

@@ -26,3 +26,7 @@ __all__ = [
     "RolloutWorker", "PPO", "PPOConfig", "PPOLearner",
     "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
 ]
+
+from ray_tpu._private import usage as _usage  # noqa: E402
+_usage.record_library_usage("rllib")
+del _usage

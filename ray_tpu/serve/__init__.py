@@ -30,3 +30,7 @@ __all__ = [
     "get_deployment_handle", "run", "shutdown", "start", "status",
     "AutoscalingConfig", "DeploymentHandle", "DeploymentResponse",
 ]
+
+from ray_tpu._private import usage as _usage  # noqa: E402
+_usage.record_library_usage("serve")
+del _usage

@@ -32,3 +32,7 @@ __all__ = [
     "get_world_rank", "get_world_size", "get_local_rank", "get_context",
     "DataParallelTrainer", "JaxTrainer",
 ]
+
+from ray_tpu._private import usage as _usage  # noqa: E402
+_usage.record_library_usage("train")
+del _usage

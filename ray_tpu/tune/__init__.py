@@ -26,3 +26,7 @@ __all__ = [
     "TuneConfig", "Tuner",
     "ResultGrid", "report",
 ]
+
+from ray_tpu._private import usage as _usage  # noqa: E402
+_usage.record_library_usage("tune")
+del _usage

@@ -1,0 +1,98 @@
+"""Serializability inspection (reference: python/ray/util/check_serialize.py
+— ``inspect_serializability`` walks an object's closure/globals and reports
+which inner member fails to pickle, so users can fix captures instead of
+staring at an opaque cloudpickle traceback).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional, Set, Tuple
+
+import cloudpickle
+
+
+class FailureTuple:
+    """One non-serializable member: the object, its name, and its parent."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailTuple({self.name} [obj={self.obj!r}, parent={self.parent!r}])"
+
+
+def _is_serializable(obj: Any) -> bool:
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def _inspect_recursive(obj: Any, name: str, depth: int,
+                       failures: list, seen: Set[int]) -> None:
+    if depth <= 0 or id(obj) in seen:
+        return
+    seen.add(id(obj))
+
+    found_inner = False
+    members: list = []
+    if inspect.isfunction(obj):
+        # closure cells + referenced globals are where captures hide
+        if obj.__closure__:
+            names = obj.__code__.co_freevars
+            for nm, cell in zip(names, obj.__closure__):
+                try:
+                    members.append((nm, cell.cell_contents))
+                except ValueError:
+                    pass
+        for nm in obj.__code__.co_names:
+            if nm in obj.__globals__:
+                members.append((nm, obj.__globals__[nm]))
+    elif inspect.isclass(obj):
+        members = [(nm, v) for nm, v in vars(obj).items()
+                   if not nm.startswith("__")]
+    elif hasattr(obj, "__dict__") and not inspect.ismodule(obj):
+        members = list(vars(obj).items())
+
+    for nm, member in members:
+        if _is_serializable(member):
+            continue
+        found_inner = True
+        _inspect_recursive(member, nm, depth - 1, failures, seen)
+        if not any(f.obj is member for f in failures):
+            failures.append(FailureTuple(member, nm, obj))
+
+    if not found_inner:
+        failures.append(FailureTuple(obj, name, None))
+
+
+def inspect_serializability(
+        obj: Any, name: Optional[str] = None,
+        depth: int = 3, print_failures: bool = True,
+) -> Tuple[bool, Set[FailureTuple]]:
+    """Check ``obj`` for cloudpickle serializability; on failure, descend
+    into closures/globals/attributes to find the smallest failing member.
+
+    Returns ``(serializable, failures)``.
+    """
+    name = name or getattr(obj, "__name__", str(obj))
+    if _is_serializable(obj):
+        return True, set()
+    failures: list = []
+    _inspect_recursive(obj, name, depth, failures, seen=set())
+    # de-dup by identity, keep innermost first
+    uniq, seen_ids = [], set()
+    for f in failures:
+        if id(f.obj) not in seen_ids:
+            seen_ids.add(id(f.obj))
+            uniq.append(f)
+    if print_failures:
+        print(f"Checking serializability of {name!r}: FAILED")
+        for f in uniq:
+            where = f" (captured by {f.parent!r})" if f.parent is not None else ""
+            print(f"  non-serializable: {f.name!r} = {f.obj!r}{where}")
+    return False, set(uniq)

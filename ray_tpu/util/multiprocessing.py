@@ -1,0 +1,231 @@
+"""``multiprocessing.Pool``-compatible API over ray_tpu actors.
+
+Role-equivalent to the reference's drop-in Pool shim
+(reference: python/ray/util/multiprocessing/pool.py — a Pool whose
+workers are actors, so it scales past one host and composes with the
+cluster scheduler). The surface mirrors the stdlib: ``apply``,
+``apply_async``, ``map``, ``map_async``, ``starmap``, ``imap``,
+``imap_unordered``, ``close``/``terminate``/``join``, and context-manager
+use. ``AsyncResult`` wraps object refs.
+
+Differences from the stdlib (same as the reference's): ``initializer``
+runs once per actor, not per task; worker death surfaces as a task error
+on ``get`` rather than a hung pool.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import ray_tpu
+
+
+class TimeoutError(Exception):  # noqa: A001 - mirrors multiprocessing's name
+    pass
+
+
+def _chunks(seq: List[Any], size: int):
+    for i in range(0, len(seq), size):
+        yield seq[i:i + size]
+
+
+class _PoolActor:
+    """One pool worker; applies function chunks in-process."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_chunk(self, fn, chunk, star):
+        if star:
+            return [fn(*item) for item in chunk]
+        return [fn(item) for item in chunk]
+
+    def ping(self):
+        return True
+
+
+class AsyncResult:
+    """Mirrors ``multiprocessing.pool.AsyncResult``."""
+
+    def __init__(self, refs: Sequence[Any], single: bool,
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
+        self._refs = list(refs)
+        self._single = single
+        self._callback = callback
+        self._error_callback = error_callback
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._collector = threading.Thread(target=self._collect, daemon=True)
+        self._collector.start()
+
+    def _collect(self):
+        try:
+            parts = ray_tpu.get(self._refs)
+            flat = [v for part in parts for v in part]
+            self._value = flat[0] if self._single else flat
+            if self._callback is not None:
+                try:
+                    self._callback(self._value)
+                except Exception:
+                    pass
+        except BaseException as e:  # surfaced on .get()
+            self._error = e
+            if self._error_callback is not None:
+                try:
+                    self._error_callback(e)
+                except Exception:
+                    pass
+        finally:
+            self._done.set()
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        return self._error is None
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._done.wait(timeout)
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("result not ready within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class Pool:
+    """Actor-backed process pool (reference: util/multiprocessing/pool.py)."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = (),
+                 ray_remote_args: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            processes = max(1, int(
+                ray_tpu.cluster_resources().get("CPU", 1)))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        opts = dict(ray_remote_args or {})
+        actor_cls = ray_tpu.remote(**opts)(_PoolActor) if opts \
+            else ray_tpu.remote(_PoolActor)
+        self._actors = [actor_cls.remote(initializer, initargs)
+                        for _ in range(processes)]
+        ray_tpu.get([a.ping.remote() for a in self._actors])
+        self._processes = processes
+        self._rr = itertools.cycle(range(processes))
+        self._closed = False
+
+    # ------------------------------------------------------------ submit
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _submit_chunks(self, fn, items: List[Any], chunksize: Optional[int],
+                       star: bool) -> List[Any]:
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        refs = []
+        for chunk in _chunks(items, chunksize):
+            actor = self._actors[next(self._rr)]
+            refs.append(actor.run_chunk.remote(fn, chunk, star))
+        return refs
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: Optional[dict] = None,
+                    callback: Optional[Callable] = None,
+                    error_callback: Optional[Callable] = None) -> AsyncResult:
+        self._check_open()
+        kwds = kwds or {}
+        actor = self._actors[next(self._rr)]
+        call = (lambda a: fn(*a, **kwds))
+        ref = actor.run_chunk.remote(call, [args], False)
+        return AsyncResult([ref], single=True, callback=callback,
+                           error_callback=error_callback)
+
+    def map(self, fn: Callable, iterable: Iterable[Any],
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable[Any],
+                  chunksize: Optional[int] = None,
+                  callback: Optional[Callable] = None,
+                  error_callback: Optional[Callable] = None) -> AsyncResult:
+        self._check_open()
+        items = list(iterable)
+        refs = self._submit_chunks(fn, items, chunksize, star=False)
+        return AsyncResult(refs, single=False, callback=callback,
+                           error_callback=error_callback)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn: Callable, iterable: Iterable[tuple],
+                      chunksize: Optional[int] = None,
+                      callback: Optional[Callable] = None,
+                      error_callback: Optional[Callable] = None) -> AsyncResult:
+        self._check_open()
+        items = [tuple(x) for x in iterable]
+        refs = self._submit_chunks(fn, items, chunksize, star=True)
+        return AsyncResult(refs, single=False, callback=callback,
+                           error_callback=error_callback)
+
+    def imap(self, fn: Callable, iterable: Iterable[Any],
+             chunksize: int = 1):
+        self._check_open()
+        items = list(iterable)
+        refs = self._submit_chunks(fn, items, chunksize, star=False)
+        for ref in refs:
+            for v in ray_tpu.get(ref):
+                yield v
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable[Any],
+                       chunksize: int = 1):
+        self._check_open()
+        items = list(iterable)
+        refs = self._submit_chunks(fn, items, chunksize, star=False)
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            for v in ray_tpu.get(ready[0]):
+                yield v
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False

@@ -21,3 +21,7 @@ from ray_tpu.workflow.execution import (  # noqa: F401
 
 __all__ = ["init", "run", "run_async", "resume", "get_status",
            "get_output", "list_all"]
+
+from ray_tpu._private import usage as _usage  # noqa: E402
+_usage.record_library_usage("workflow")
+del _usage

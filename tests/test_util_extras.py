@@ -1,0 +1,120 @@
+"""Tests for util extras: multiprocessing Pool shim, check_serialize,
+usage stats (reference analogs: util/multiprocessing/pool.py,
+util/check_serialize.py, _private/usage/usage_lib.py)."""
+
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.check_serialize import inspect_serializability
+
+
+def _sq(x):
+    return x * x
+
+
+def test_pool_map(ray_start_regular):
+    with Pool(2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+
+
+def test_pool_apply_and_async(ray_start_regular):
+    with Pool(2) as p:
+        assert p.apply(_sq, (7,)) == 49
+        r = p.apply_async(_sq, (8,))
+        assert r.get(timeout=30) == 64
+        assert r.ready() and r.successful()
+
+
+def test_pool_starmap_imap(ray_start_regular):
+    with Pool(2) as p:
+        assert p.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+        assert list(p.imap(_sq, range(6), chunksize=2)) == \
+            [x * x for x in range(6)]
+        assert sorted(p.imap_unordered(_sq, range(6), chunksize=2)) == \
+            sorted(x * x for x in range(6))
+
+
+def test_pool_error_and_callbacks(ray_start_regular):
+    def boom(x):
+        raise ValueError("boom")
+
+    with Pool(1) as p:
+        r = p.apply_async(boom, (1,))
+        with pytest.raises(Exception):
+            r.get(timeout=30)
+        assert not r.successful()
+
+        got = []
+        done = threading.Event()
+        r2 = p.map_async(_sq, [1, 2, 3],
+                         callback=lambda v: (got.append(v), done.set()))
+        assert r2.get(timeout=30) == [1, 4, 9]
+        assert done.wait(5) and got == [[1, 4, 9]]
+
+
+def test_pool_initializer(ray_start_regular):
+    def init_fn(v):
+        import os
+        os.environ["_POOL_INIT"] = str(v)
+
+    def read_init(_):
+        import os
+        return os.environ.get("_POOL_INIT")
+
+    with Pool(2, initializer=init_fn, initargs=(42,)) as p:
+        assert p.map(read_init, range(4)) == ["42"] * 4
+
+
+def test_pool_lifecycle(ray_start_regular):
+    p = Pool(1)
+    with pytest.raises(ValueError):
+        p.join()  # not closed yet
+    p.close()
+    p.join()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+
+
+def test_check_serialize_ok():
+    ok, failures = inspect_serializability(lambda x: x + 1,
+                                           print_failures=False)
+    assert ok and not failures
+
+
+def test_check_serialize_finds_capture():
+    lock = threading.Lock()
+
+    def f(x):
+        with lock:
+            return x
+
+    ok, failures = inspect_serializability(f, print_failures=False)
+    assert not ok
+    assert any(t.name == "lock" for t in failures)
+
+
+def test_usage_stats(ray_start_regular):
+    from ray_tpu._private import usage
+    import ray_tpu.train  # noqa: F401  (records library usage)
+
+    usage.record_library_usage("train")
+    usage.record_extra_usage_tag("test_tag", "on")
+    stats = usage.get_usage_stats()
+    assert stats is not None
+    assert "train" in stats["libraries_used"]
+    assert stats["extra_tags"].get("test_tag") == "on"
+    path = usage.write_usage_report()
+    assert path is not None
+    import json
+    with open(path) as f:
+        assert json.load(f)["ray_tpu_version"]
+
+
+def test_usage_stats_opt_out(ray_start_regular, monkeypatch):
+    from ray_tpu._private import usage
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    assert not usage.usage_stats_enabled()
+    assert usage.write_usage_report() is None
