@@ -1,29 +1,40 @@
 """Reinforcement learning library (reference: ``rllib/`` — ~35 algorithms
-on ``Algorithm(Trainable)`` ``algorithms/algorithm.py:146``; this slice
-ships PPO (on-policy) and DQN (off-policy replay) on the Learner
-architecture, SURVEY.md §7 step 8).
+on ``Algorithm(Trainable)`` ``algorithms/algorithm.py:146``; this library
+ships the on-policy family (PPO, A2C), the async off-policy-corrected
+family (IMPALA w/ V-trace), and replay off-policy (DQN) on a unified
+Algorithm/Learner architecture, SURVEY.md §7 step 8).
 
 Architecture (TPU-first version of the reference's split):
 - ``RolloutWorker`` actors sample environments on CPU hosts
   (reference: ``evaluation/rollout_worker.py:166``).
-- The ``PPOLearner`` runs jitted minibatch updates — on TPU chips the
-  learner actor pins chips and the update is one compiled program
-  (reference: ``core/learner/learner.py:89`` multi-GPU Learner).
-- ``PPO.train()`` = broadcast weights → parallel sample → learner update
-  (reference: ``algorithms/algorithm.py:1309-1381`` training_step).
+- Learners run jitted updates — on TPU chips the learner actor pins
+  chips and the update is one compiled program (reference:
+  ``core/learner/learner.py:89``); ``LearnerGroup`` runs them
+  data-parallel (reference: ``core/learner/learner_group.py:51``).
+- ``Algorithm.train()`` wraps each algorithm's ``training_step``
+  (reference: ``algorithms/algorithm.py:1309-1381``).
 """
 
 from ray_tpu.rllib.sample_batch import SampleBatch, concat_batches  # noqa: F401
 from ray_tpu.rllib.policy import MLPPolicy, PolicySpec  # noqa: F401
 from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.learner_group import LearnerGroup  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner  # noqa: F401
+from ray_tpu.rllib.a2c import A2C, A2CConfig, A2CLearner  # noqa: F401
+from ray_tpu.rllib.impala import (  # noqa: F401
+    IMPALA, IMPALAConfig, IMPALALearner,
+)
 from ray_tpu.rllib.dqn import (  # noqa: F401
     DQN, DQNConfig, DQNLearner, ReplayBuffer,
 )
 
 __all__ = [
     "SampleBatch", "concat_batches", "MLPPolicy", "PolicySpec",
-    "RolloutWorker", "PPO", "PPOConfig", "PPOLearner",
+    "RolloutWorker", "Algorithm", "AlgorithmConfig", "LearnerGroup",
+    "PPO", "PPOConfig", "PPOLearner",
+    "A2C", "A2CConfig", "A2CLearner",
+    "IMPALA", "IMPALAConfig", "IMPALALearner",
     "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
 ]
 

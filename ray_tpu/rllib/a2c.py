@@ -1,0 +1,106 @@
+"""A2C — synchronous advantage actor-critic (reference:
+``rllib/algorithms/a2c/a2c.py`` — A3C's sync variant: gather GAE
+fragments from all workers, one gradient step on the joint batch).
+
+The simplest member of the policy-gradient family here: no ratio
+clipping (PPO), no off-policy correction (IMPALA) — the batch is exactly
+on-policy because sampling is barriered each iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, Learner
+from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, ADVANTAGES, OBS, RETURNS, SampleBatch, concat_batches,
+)
+
+
+@dataclasses.dataclass
+class A2CConfig(AlgorithmConfig):
+    lam: float = 1.0          # plain n-step returns by default
+    lr: float = 1e-3
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    microbatch_size: int = 0  # 0 = single step on the whole batch
+
+
+class A2CLearner(Learner):
+    """Jitted vanilla policy-gradient + value update."""
+
+    def __init__(self, spec: PolicySpec, config: A2CConfig):
+        import jax
+        import jax.numpy as jnp
+
+        vf_c, ent_c = config.vf_coeff, config.entropy_coeff
+
+        def loss_fn(params, batch):
+            logits, values = MLPPolicy.forward(params, batch[OBS])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch[ACTIONS][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            adv = batch[ADVANTAGES]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pi_loss = -jnp.mean(logp * adv)
+            vf_loss = jnp.mean((values - batch[RETURNS]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pi_loss + vf_c * vf_loss - ent_c * entropy
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        super().__init__(spec, config, loss_fn)
+
+    def update_from_batch(self, batch: SampleBatch,
+                          microbatch_size: int = 0) -> Dict[str, float]:
+        n = batch.count
+        if microbatch_size and microbatch_size < n:
+            # Include the ragged tail so no transition is dropped (one
+            # extra XLA compile for the tail shape, cached thereafter).
+            metrics: Dict[str, float] = {}
+            for i in range(0, n, microbatch_size):
+                sub = SampleBatch(
+                    {k: v[i:i + microbatch_size] for k, v in batch.items()})
+                metrics = self.step(sub)
+            return metrics
+        return self.step(batch)
+
+
+class A2C(Algorithm):
+    def setup(self) -> None:
+        import ray_tpu
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        config = self.config
+        self.learner = A2CLearner(self.spec, config)
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=1).remote(
+                config.env_creator, self.spec, gamma=config.gamma,
+                lam=config.lam,
+                rollout_fragment_length=config.rollout_fragment_length,
+                seed=config.seed + 1 + i)
+            for i in range(config.num_rollout_workers)
+        ]
+
+    def training_step(self) -> Dict[str, float]:
+        import ray_tpu
+
+        weights = self.learner.get_weights()
+        batches = ray_tpu.get(
+            [w.sample.remote(weights) for w in self.workers])
+        batch = concat_batches(batches)
+        learn_metrics = self.learner.update_from_batch(
+            batch, self.config.microbatch_size)
+        return {
+            "timesteps_this_iter": batch.count,
+            "episode_return_mean": self._mean_returns_from(batches),
+            **learn_metrics,
+        }
+
+
+A2CConfig._algo_cls = A2C

@@ -1,0 +1,292 @@
+"""Unified Algorithm / AlgorithmConfig base (reference:
+``rllib/algorithms/algorithm.py:146`` — Algorithm is a Tune Trainable with
+a ``training_step`` override point; ``algorithm_config.py`` is the
+chainable config builder).
+
+Every algorithm here follows the same lifecycle: a chainable config
+(``.environment().rollouts().training().build()``), a ``setup()`` that
+creates the learner + rollout actors, a per-iteration ``training_step()``,
+and shared checkpoint/save/restore + Tune integration on the base class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import time
+from typing import Any, Callable, ClassVar, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy import PolicySpec
+
+
+@dataclasses.dataclass
+class AlgorithmConfig:
+    """Chainable builder shared by all algorithms (reference:
+    ``algorithm_config.py`` — env/rollouts/training/resources sections)."""
+
+    env_creator: Optional[Callable[[], Any]] = None
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 200
+    gamma: float = 0.99
+    lr: float = 3e-4
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    # obs/action space; inferred from a probe env if None
+    obs_dim: Optional[int] = None
+    num_actions: Optional[int] = None
+
+    # set by each subclass to its Algorithm class (not a dataclass field)
+    _algo_cls: ClassVar[Any] = None
+
+    def environment(self, env_creator) -> "AlgorithmConfig":
+        self.env_creator = env_creator
+        return self
+
+    def rollouts(self, *, num_rollout_workers: int = None,
+                 rollout_fragment_length: int = None) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k) or k.startswith("_"):
+                raise ValueError(
+                    f"unknown {type(self).__name__} option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def infer_spaces(self) -> None:
+        """Fill obs_dim/num_actions from a probe env instance."""
+        if self.obs_dim is not None and self.num_actions is not None:
+            return
+        if self.env_creator is None:
+            raise ValueError(
+                f"{type(self).__name__}.environment(env_creator) required")
+        probe = self.env_creator()
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        self.num_actions = int(probe.action_space.n)
+        close = getattr(probe, "close", None)
+        if close:
+            close()
+
+    def build(self) -> "Algorithm":
+        if self._algo_cls is None:
+            raise ValueError(
+                f"{type(self).__name__} is not bound to an Algorithm")
+        return self._algo_cls(self)
+
+
+class Learner:
+    """Shared learner machinery (reference: ``core/learner/learner.py:89``
+    — params + optimizer + jitted update built from a loss function).
+
+    Subclasses pass ``loss_fn(params, batch) -> (loss, aux_dict)`` and get
+    the jitted SGD step, the gradient split used by
+    :class:`~ray_tpu.rllib.learner_group.LearnerGroup`, and the
+    checkpointable state accessors. Algorithms with non-standard update
+    signatures (e.g. DQN's target network) override ``_build_update`` or
+    the state hooks.
+    """
+
+    def __init__(self, spec: PolicySpec, config: AlgorithmConfig,
+                 loss_fn: Callable):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.policy import MLPPolicy
+
+        self.policy = MLPPolicy(spec)
+        self.optimizer = optax.adam(config.lr)
+        self.params = self.policy.init(jax.random.key(config.seed))
+        self.opt_state = self.optimizer.init(self.params)
+        self._build_update(loss_fn)
+
+    def _build_update(self, loss_fn: Callable) -> None:
+        import jax
+
+        def update(params, opt_state, batch):
+            (total, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            aux["total_loss"] = total
+            return params, opt_state, aux
+
+        def grads_only(params, batch):
+            (total, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            aux["total_loss"] = total
+            return grads, aux
+
+        def apply(params, opt_state, grads):
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            return params, opt_state
+
+        self._update = jax.jit(update)
+        self._grads = jax.jit(grads_only)
+        self._apply = jax.jit(apply)
+
+    def step(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        """One jitted SGD step on the batch; returns float metrics."""
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, dict(batch))
+        return {k: float(v) for k, v in aux.items()}
+
+    # -- LearnerGroup protocol (reference: Learner.compute_gradients /
+    #    apply_gradients) --------------------------------------------------
+
+    def compute_grads(self, batch: Dict[str, Any]):
+        grads, aux = self._grads(self.params, dict(batch))
+        return grads, {k: float(v) for k, v in aux.items()}
+
+    def apply_grads(self, grads) -> None:
+        self.params, self.opt_state = self._apply(
+            self.params, self.opt_state, grads)
+
+    # -- weights / checkpointable state ------------------------------------
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+class Algorithm:
+    """Base algorithm: train loop bookkeeping, checkpoints, Tune adapter.
+
+    Subclasses implement ``setup()`` (create ``self.learner`` and
+    ``self.workers``) and ``training_step() -> metrics dict``.
+    """
+
+    def __init__(self, config: AlgorithmConfig):
+        if config.env_creator is None:
+            raise ValueError(
+                f"{type(config).__name__}.environment(env_creator) required")
+        self.config = config
+        config.infer_spaces()
+        self.spec = PolicySpec(config.obs_dim, config.num_actions,
+                               config.hidden)
+        self._np_rng = np.random.default_rng(config.seed)
+        self.iteration = 0
+        self.timesteps_total = 0
+        self.learner: Any = None
+        self.workers: List[Any] = []
+        self.setup()
+
+    # ------------------------------------------------------------ overrides
+
+    def setup(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ train loop
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration (reference: ``algorithm.py:1309`` training_step
+        wrapped with iteration/timestep bookkeeping)."""
+        t0 = time.perf_counter()
+        metrics = self.training_step()
+        dt = time.perf_counter() - t0
+        self.iteration += 1
+        steps = metrics.get("timesteps_this_iter", 0)
+        self.timesteps_total += steps
+        metrics.setdefault("training_iteration", self.iteration)
+        metrics.setdefault("timesteps_total", self.timesteps_total)
+        if steps and "env_steps_per_sec" not in metrics:
+            metrics["env_steps_per_sec"] = steps / dt
+        return metrics
+
+    @staticmethod
+    def _mean_returns_from(batches) -> Optional[float]:
+        """Mean completed-episode return piggybacked on sample batches
+        (non-blocking: no extra RPC behind in-flight sample tasks)."""
+        returns: List[float] = []
+        for b in batches:
+            returns.extend(getattr(b, "completed_returns", None)
+                           or b.get("completed_returns", ()))
+        return float(np.mean(returns)) if returns else None
+
+    # ------------------------------------------------------------ weights
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.learner.set_weights(weights)
+
+    # ------------------------------------------------------------ checkpoint
+
+    def save_checkpoint(self, path: str) -> str:
+        """Write weights + iteration counters (reference:
+        ``Algorithm.save_checkpoint``); returns the checkpoint file path."""
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "learner_state": self.learner.get_state(),
+            "iteration": self.iteration,
+            "timesteps_total": self.timesteps_total,
+            "config": dataclasses.asdict(
+                dataclasses.replace(self.config, env_creator=None)),
+        }
+        file = os.path.join(path, "algorithm_state.pkl")
+        with open(file, "wb") as f:
+            pickle.dump(state, f)
+        return file
+
+    def restore_checkpoint(self, path: str) -> None:
+        file = path if path.endswith(".pkl") else os.path.join(
+            path, "algorithm_state.pkl")
+        with open(file, "rb") as f:
+            state = pickle.load(f)
+        self.learner.set_state(state["learner_state"])
+        self.iteration = state["iteration"]
+        self.timesteps_total = state["timesteps_total"]
+
+    # ------------------------------------------------------------ lifecycle
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+
+    @classmethod
+    def as_trainable(cls, base_config: AlgorithmConfig,
+                     stop_iters: int = 10) -> Callable:
+        """Function trainable for the Tuner (reference: Algorithm IS a
+        Trainable; here a closure reporting per-iteration metrics)."""
+
+        def trainable(tune_config: Dict[str, Any]):
+            from ray_tpu.train import session
+
+            cfg = dataclasses.replace(base_config, **tune_config)
+            algo = cls(cfg)
+            try:
+                for _ in range(stop_iters):
+                    session.report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

@@ -17,15 +17,13 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, Learner
 from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
 
 
 @dataclasses.dataclass
-class DQNConfig:
-    env_creator: Optional[Callable[[], Any]] = None
-    num_rollout_workers: int = 2
+class DQNConfig(AlgorithmConfig):
     rollout_fragment_length: int = 100
-    gamma: float = 0.99
     lr: float = 1e-3
     buffer_size: int = 50_000
     learning_starts: int = 500
@@ -36,32 +34,6 @@ class DQNConfig:
     epsilon_start: float = 1.0
     epsilon_end: float = 0.05
     epsilon_decay_steps: int = 5_000
-    hidden: tuple = (64, 64)
-    seed: int = 0
-    obs_dim: Optional[int] = None
-    num_actions: Optional[int] = None
-
-    def environment(self, env_creator) -> "DQNConfig":
-        self.env_creator = env_creator
-        return self
-
-    def rollouts(self, *, num_rollout_workers: int = None,
-                 rollout_fragment_length: int = None) -> "DQNConfig":
-        if num_rollout_workers is not None:
-            self.num_rollout_workers = num_rollout_workers
-        if rollout_fragment_length is not None:
-            self.rollout_fragment_length = rollout_fragment_length
-        return self
-
-    def training(self, **kwargs) -> "DQNConfig":
-        for k, v in kwargs.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown DQN option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def build(self) -> "DQN":
-        return DQN(self)
 
 
 class ReplayBuffer:
@@ -95,19 +67,13 @@ class ReplayBuffer:
                 "next_obs": self.next_obs[idx], "dones": self.dones[idx]}
 
 
-class DQNLearner:
+class DQNLearner(Learner):
     """Jitted double-DQN TD update with target network."""
 
     def __init__(self, spec: PolicySpec, config: DQNConfig):
         import jax
         import jax.numpy as jnp
-        import optax
 
-        self.policy = MLPPolicy(spec)
-        self.optimizer = optax.adam(config.lr)
-        self.params = self.policy.init(jax.random.key(config.seed))
-        self.target_params = jax.tree.map(lambda x: x, self.params)
-        self.opt_state = self.optimizer.init(self.params)
         self.num_updates = 0
         self._target_freq = config.target_update_freq
         gamma, double_q = config.gamma, config.double_q
@@ -139,6 +105,14 @@ class DQNLearner:
             return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
                           "q_mean": jnp.mean(q_sel)}
 
+        super().__init__(spec, config, loss_fn)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+
+    def _build_update(self, loss_fn) -> None:
+        # TD loss takes the extra target-network pytree, so the generic
+        # (params, batch) update from the base class does not apply.
+        import jax
+
         def update(params, target_params, opt_state, batch):
             (loss, aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, target_params, batch)
@@ -165,11 +139,14 @@ class DQNLearner:
                 self.target_params = jax.tree.map(lambda x: x, self.params)
         return {k: float(v) for k, v in aux.items()}
 
-    def get_weights(self):
-        return self.params
+    def get_state(self):
+        return {**super().get_state(), "target_params": self.target_params,
+                "num_updates": self.num_updates}
 
-    def set_weights(self, params):
-        self.params = params
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = state["target_params"]
+        self.num_updates = state["num_updates"]
 
 
 class _DQNRolloutWorker:
@@ -218,38 +195,24 @@ class _DQNRolloutWorker:
         return {"obs": np.stack(obs_b), "actions": np.asarray(act_b),
                 "rewards": np.asarray(rew_b, np.float32),
                 "next_obs": np.stack(nxt_b),
-                "dones": np.asarray(done_b, np.float32)}
+                "dones": np.asarray(done_b, np.float32),
+                "completed_returns": self.episode_returns()}
 
     def episode_returns(self) -> List[float]:
         out, self._completed = self._completed, []
         return out
 
 
-class DQN:
+class DQN(Algorithm):
     """The Algorithm (reference: dqn.py DQN(Algorithm) training_step:
     sample -> store -> replay-train -> target sync)."""
 
-    def __init__(self, config: DQNConfig):
+    def setup(self) -> None:
         import ray_tpu
 
-        if config.env_creator is None:
-            raise ValueError("DQNConfig.environment(env_creator) required")
-        self.config = config
-        if config.obs_dim is None or config.num_actions is None:
-            probe = config.env_creator()
-            config.obs_dim = int(np.prod(probe.observation_space.shape))
-            config.num_actions = int(probe.action_space.n)
-            close = getattr(probe, "close", None)
-            if close:
-                close()
-        self.spec = PolicySpec(config.obs_dim, config.num_actions,
-                               config.hidden)
+        config = self.config
         self.learner = DQNLearner(self.spec, config)
         self.buffer = ReplayBuffer(config.buffer_size, config.obs_dim)
-        self._np_rng = np.random.default_rng(config.seed)
-        self.total_env_steps = 0
-        self.iteration = 0
-
         worker_cls = ray_tpu.remote(_DQNRolloutWorker)
         self.workers = [
             worker_cls.options(num_cpus=1).remote(
@@ -261,13 +224,12 @@ class DQN:
 
     def _epsilon(self) -> float:
         c = self.config
-        frac = min(1.0, self.total_env_steps / max(1, c.epsilon_decay_steps))
+        frac = min(1.0, self.timesteps_total / max(1, c.epsilon_decay_steps))
         return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
 
-    def train(self) -> Dict[str, Any]:
+    def training_step(self) -> Dict[str, Any]:
         import ray_tpu
 
-        t0 = time.perf_counter()
         eps = self._epsilon()
         weights = self.learner.get_weights()
         batches = ray_tpu.get(
@@ -275,55 +237,19 @@ class DQN:
         for b in batches:
             self.buffer.add_batch(b["obs"], b["actions"], b["rewards"],
                                   b["next_obs"], b["dones"])
-            self.total_env_steps += len(b["actions"])
         learn_metrics: Dict[str, float] = {}
         if self.buffer.size >= self.config.learning_starts:
             learn_metrics = self.learner.update_from_buffer(
                 self.buffer, iters=self.config.num_sgd_iters,
                 batch_size=self.config.train_batch_size, rng=self._np_rng)
-        returns: List[float] = []
-        for r in ray_tpu.get(
-                [w.episode_returns.remote() for w in self.workers]):
-            returns.extend(r)
-        dt = time.perf_counter() - t0
         steps = sum(len(b["actions"]) for b in batches)
-        self.iteration += 1
         return {
-            "training_iteration": self.iteration,
-            "timesteps_total": self.total_env_steps,
             "timesteps_this_iter": steps,
-            "env_steps_per_sec": steps / dt,
             "epsilon": eps,
             "buffer_size": self.buffer.size,
-            "episode_return_mean": float(np.mean(returns))
-            if returns else None,
+            "episode_return_mean": self._mean_returns_from(batches),
             **learn_metrics,
         }
 
-    def get_weights(self):
-        return self.learner.get_weights()
 
-    def stop(self):
-        import ray_tpu
-
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
-
-    @classmethod
-    def as_trainable(cls, base_config: "DQNConfig",
-                     stop_iters: int = 10) -> Callable:
-        def trainable(tune_config: Dict[str, Any]):
-            from ray_tpu.train import session
-
-            cfg = dataclasses.replace(base_config, **tune_config)
-            algo = cls(cfg)
-            try:
-                for _ in range(stop_iters):
-                    session.report(algo.train())
-            finally:
-                algo.stop()
-
-        return trainable
+DQNConfig._algo_cls = DQN

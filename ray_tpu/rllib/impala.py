@@ -1,0 +1,149 @@
+"""IMPALA (reference: ``rllib/algorithms/impala/impala.py`` — asynchronous
+sampling decoupled from learning, with V-trace off-policy correction
+[Espeholt et al. 2018]).
+
+Rollout actors sample continuously with the weights they were last
+handed; the learner consumes fragments as they arrive, so sampling and
+learning overlap instead of lock-stepping (PPO's sync pattern). The
+policy-lag this introduces is exactly what V-trace corrects.
+
+TPU-native: the whole V-trace + actor-critic update is ONE jitted
+program per fragment (``lax.scan`` inside jit for the backward
+recursion), so the learner step is a single XLA launch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, Learner
+from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, LOGPS, NEXT_VALUES, OBS, REWARDS, SampleBatch,
+)
+from ray_tpu.rllib.vtrace import vtrace
+
+
+@dataclasses.dataclass
+class IMPALAConfig(AlgorithmConfig):
+    lr: float = 6e-4
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    clip_rho_threshold: float = 1.0
+    clip_c_threshold: float = 1.0
+    # max fragments consumed per training_step (bounds iteration latency)
+    max_fragments_per_step: int = 8
+
+
+class IMPALALearner(Learner):
+    """Jitted V-trace actor-critic update over one time-major fragment."""
+
+    def __init__(self, spec: PolicySpec, config: IMPALAConfig):
+        import jax
+        import jax.numpy as jnp
+
+        gamma = config.gamma
+        vf_c, ent_c = config.vf_coeff, config.entropy_coeff
+        rho_bar, c_bar = config.clip_rho_threshold, config.clip_c_threshold
+
+        def loss_fn(params, batch):
+            logits, values = MLPPolicy.forward(params, batch[OBS])
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch[ACTIONS][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            discounts = gamma * (1.0 - batch[DONES].astype(jnp.float32))
+            # Learner values at t; t+1 uses the learner's own estimates
+            # shifted one step, with the sampler's bootstrap at the tail
+            # (the one value not recomputable from the fragment's obs).
+            next_values = jnp.concatenate(
+                [values[1:], batch[NEXT_VALUES][-1:]], axis=0)
+            vt = vtrace(
+                behavior_logp=batch[LOGPS], target_logp=target_logp,
+                rewards=batch[REWARDS], values=values,
+                next_values=next_values, discounts=discounts,
+                clip_rho_threshold=rho_bar, clip_c_threshold=c_bar)
+            pi_loss = -jnp.mean(target_logp * vt.pg_advantages)
+            vf_loss = 0.5 * jnp.mean((vt.vs - values) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pi_loss + vf_c * vf_loss - ent_c * entropy
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        super().__init__(spec, config, loss_fn)
+
+    def update_from_fragment(self, batch: SampleBatch) -> Dict[str, float]:
+        return self.step(batch)
+
+
+class IMPALA(Algorithm):
+    """Async actor-learner loop (reference: ``impala.py`` training_step —
+    sample results are consumed as they complete, not barriered)."""
+
+    def setup(self) -> None:
+        import ray_tpu
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        config = self.config
+        self.learner = IMPALALearner(self.spec, config)
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=1).remote(
+                config.env_creator, self.spec, gamma=config.gamma,
+                lam=0.0,  # GAE unused by V-trace; keep fields cheap
+                rollout_fragment_length=config.rollout_fragment_length,
+                seed=config.seed + 1 + i)
+            for i in range(config.num_rollout_workers)
+        ]
+        # ref -> worker for the continuously-inflight sample tasks
+        self._inflight: Dict[Any, Any] = {}
+
+    def _submit(self, worker) -> None:
+        ref = worker.sample.remote(self.learner.get_weights())
+        self._inflight[ref] = worker
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        if not self._inflight:
+            for w in self.workers:
+                self._submit(w)
+
+        steps = 0
+        learn_metrics: Dict[str, float] = {}
+        consumed = 0
+        fragments = []
+        while consumed < self.config.max_fragments_per_step:
+            pending = list(self._inflight)
+            # Block for the first fragment; afterwards only drain what is
+            # already done so the iteration doesn't barrier on stragglers.
+            timeout = None if consumed == 0 else 0
+            ready, _ = ray_tpu.wait(pending, num_returns=1, timeout=timeout)
+            if not ready:
+                break
+            ref = ready[0]
+            worker = self._inflight.pop(ref)
+            fragment = ray_tpu.get(ref)
+            learn_metrics = self.learner.update_from_fragment(fragment)
+            steps += fragment.count
+            consumed += 1
+            fragments.append(fragment)
+            self._submit(worker)  # resample with fresh weights immediately
+
+        return {
+            "timesteps_this_iter": steps,
+            "fragments_this_iter": consumed,
+            # from the consumed fragments only — never a blocking RPC
+            # behind the freshly-resubmitted sample tasks
+            "episode_return_mean": self._mean_returns_from(fragments),
+            **learn_metrics,
+        }
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()
+
+
+IMPALAConfig._algo_cls = IMPALA

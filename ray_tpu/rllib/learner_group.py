@@ -1,0 +1,120 @@
+"""Data-parallel learner group (reference:
+``rllib/core/learner/learner_group.py:51`` — ``LearnerGroup.update`` fans
+a batch across learner actors and averages gradients;
+``algorithms/algorithm.py:1349-1356`` is the call site).
+
+Replication discipline: every learner actor starts from the same seed, so
+params and optimizer state are bit-identical; each update shards the
+minibatch, averages the gradients at the driver, and applies the SAME
+averaged gradient on every learner — states stay replicated without a
+parameter broadcast (the DDP invariant, kept by construction).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+
+class _LearnerShard:
+    """Actor hosting one learner replica."""
+
+    def __init__(self, learner_factory: Callable[[], Any]):
+        self.learner = learner_factory()
+
+    def compute_grads(self, batch):
+        return self.learner.compute_grads(batch)
+
+    def apply_grads(self, grads):
+        self.learner.apply_grads(grads)
+        return True
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, w):
+        self.learner.set_weights(w)
+        return True
+
+    def get_state(self):
+        return self.learner.get_state()
+
+    def set_state(self, state):
+        self.learner.set_state(state)
+        return True
+
+
+class LearnerGroup:
+    """Drop-in for a single learner's ``update_from_batch`` surface."""
+
+    def __init__(self, learner_factory: Callable[[], Any],
+                 num_learners: int):
+        import ray_tpu
+
+        if num_learners < 1:
+            raise ValueError("num_learners must be >= 1")
+        shard_cls = ray_tpu.remote(_LearnerShard)
+        self._shards = [shard_cls.remote(learner_factory)
+                        for _ in range(num_learners)]
+        # Force identical starting state even if the factory is stochastic.
+        w0 = ray_tpu.get(self._shards[0].get_weights.remote())
+        ray_tpu.get([s.set_weights.remote(w0) for s in self._shards[1:]])
+        self._n = num_learners
+
+    @staticmethod
+    def _average(grads_list: List[Any], weights: List[int]):
+        """Example-count-weighted mean: equals the full-batch gradient of
+        a mean-reduced loss even when shards are unequal."""
+        import jax
+
+        total = sum(weights)
+        return jax.tree.map(
+            lambda *g: sum(w * gi for w, gi in zip(weights, g)) / total,
+            *grads_list)
+
+    def _sharded_step(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        """One synchronized DP gradient step over the batch."""
+        import ray_tpu
+
+        count = len(next(iter(batch.values())))
+        splits = [idx for idx in np.array_split(np.arange(count), self._n)
+                  if len(idx)]
+        refs = [s.compute_grads.remote({k: v[idx] for k, v in batch.items()})
+                for s, idx in zip(self._shards, splits)]
+        outs = ray_tpu.get(refs)
+        avg = self._average([g for g, _ in outs],
+                            [len(idx) for idx in splits])
+        ray_tpu.get([s.apply_grads.remote(avg) for s in self._shards])
+        return outs[0][1]
+
+    def update_from_batch(self, batch, *, num_epochs: int,
+                          minibatch_size: int,
+                          rng: np.random.Generator) -> Dict[str, float]:
+        metrics: Dict[str, float] = {}
+        mb = min(minibatch_size, batch.count)
+        for _ in range(num_epochs):
+            shuffled = batch.shuffle(rng)
+            for sub in shuffled.minibatches(mb):
+                metrics = self._sharded_step(dict(sub))
+        return metrics
+
+    def get_weights(self):
+        import ray_tpu
+
+        return ray_tpu.get(self._shards[0].get_weights.remote())
+
+    def set_weights(self, w) -> None:
+        import ray_tpu
+
+        ray_tpu.get([s.set_weights.remote(w) for s in self._shards])
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for s in self._shards:
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+        self._shards = []

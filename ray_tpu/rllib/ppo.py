@@ -4,18 +4,20 @@
 
 ``PPOLearner`` is a jitted clipped-surrogate update (one compiled XLA
 program per minibatch — on TPU the whole SGD epoch stays on-chip).
-``PPO.train()`` runs the canonical sync loop: broadcast weights to
-rollout actors, gather fragments, minibatch-SGD, report metrics.
+``PPO.training_step()`` runs the canonical sync loop: broadcast weights
+to rollout actors, gather fragments, minibatch-SGD, report metrics.
+With ``num_learners > 1`` the SGD runs data-parallel across learner
+actors via :class:`~ray_tpu.rllib.learner_group.LearnerGroup`.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict
 
 import numpy as np
 
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, Learner
 from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
 from ray_tpu.rllib.sample_batch import (
     ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS, SampleBatch, concat_batches,
@@ -23,60 +25,24 @@ from ray_tpu.rllib.sample_batch import (
 
 
 @dataclasses.dataclass
-class PPOConfig:
-    env_creator: Optional[Callable[[], Any]] = None
-    num_rollout_workers: int = 2
-    rollout_fragment_length: int = 200
-    gamma: float = 0.99
+class PPOConfig(AlgorithmConfig):
     lam: float = 0.95
-    lr: float = 3e-4
     clip_param: float = 0.2
     vf_coeff: float = 0.5
     entropy_coeff: float = 0.01
     num_sgd_epochs: int = 4
     sgd_minibatch_size: int = 128
-    hidden: tuple = (64, 64)
-    seed: int = 0
-    # obs/action space; inferred from a probe env if None
-    obs_dim: Optional[int] = None
-    num_actions: Optional[int] = None
-
-    def environment(self, env_creator) -> "PPOConfig":
-        self.env_creator = env_creator
-        return self
-
-    def rollouts(self, *, num_rollout_workers: int = None,
-                 rollout_fragment_length: int = None) -> "PPOConfig":
-        if num_rollout_workers is not None:
-            self.num_rollout_workers = num_rollout_workers
-        if rollout_fragment_length is not None:
-            self.rollout_fragment_length = rollout_fragment_length
-        return self
-
-    def training(self, **kwargs) -> "PPOConfig":
-        for k, v in kwargs.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown PPO option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def build(self) -> "PPO":
-        return PPO(self)
+    num_learners: int = 1  # >1: DP LearnerGroup (reference: learner_group.py)
 
 
-class PPOLearner:
+class PPOLearner(Learner):
     """Jitted PPO update (reference: ``ppo_base_learner.py`` loss;
     Learner.update ``core/learner/learner.py``)."""
 
     def __init__(self, spec: PolicySpec, config: PPOConfig):
         import jax
         import jax.numpy as jnp
-        import optax
 
-        self.policy = MLPPolicy(spec)
-        self.optimizer = optax.adam(config.lr)
-        self.params = self.policy.init(jax.random.key(config.seed))
-        self.opt_state = self.optimizer.init(self.params)
         clip, vf_c, ent_c = (config.clip_param, config.vf_coeff,
                              config.entropy_coeff)
 
@@ -100,60 +66,36 @@ class PPOLearner:
             return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
                            "entropy": entropy}
 
-        def update(params, opt_state, batch):
-            (total, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state,
-                                                       params)
-            params = jax.tree.map(lambda p, u: p + u, params, updates)
-            aux["total_loss"] = total
-            return params, opt_state, aux
-
-        self._update = jax.jit(update)
+        super().__init__(spec, config, loss_fn)
 
     def update_from_batch(self, batch: SampleBatch, *, num_epochs: int,
                           minibatch_size: int,
                           rng: np.random.Generator) -> Dict[str, float]:
-        metrics = {}
+        metrics: Dict[str, float] = {}
         mb = min(minibatch_size, batch.count)
         for _ in range(num_epochs):
             shuffled = batch.shuffle(rng)
             for sub in shuffled.minibatches(mb):
-                self.params, self.opt_state, aux = self._update(
-                    self.params, self.opt_state, dict(sub))
-        metrics = {k: float(v) for k, v in aux.items()}
+                metrics = self.step(sub)
         return metrics
 
-    def get_weights(self):
-        return self.params
 
-    def set_weights(self, params):
-        self.params = params
+class PPO(Algorithm):
+    """The Algorithm (reference: ``algorithms/algorithm.py:146``)."""
 
-
-class PPO:
-    """The Algorithm (reference: ``algorithms/algorithm.py:146`` — a Tune
-    Trainable; ``as_trainable()`` below adapts it for the Tuner)."""
-
-    def __init__(self, config: PPOConfig):
+    def setup(self) -> None:
         import ray_tpu
         from ray_tpu.rllib.rollout_worker import RolloutWorker
 
-        if config.env_creator is None:
-            raise ValueError("PPOConfig.environment(env_creator) required")
-        self.config = config
+        config = self.config
+        if config.num_learners > 1:
+            from ray_tpu.rllib.learner_group import LearnerGroup
 
-        if config.obs_dim is None or config.num_actions is None:
-            probe = config.env_creator()
-            config.obs_dim = int(np.prod(probe.observation_space.shape))
-            config.num_actions = int(probe.action_space.n)
-            close = getattr(probe, "close", None)
-            if close:
-                close()
-        self.spec = PolicySpec(config.obs_dim, config.num_actions,
-                               config.hidden)
-        self.learner = PPOLearner(self.spec, config)
-        self._np_rng = np.random.default_rng(config.seed)
+            spec, cfg = self.spec, config
+            self.learner = LearnerGroup(
+                lambda: PPOLearner(spec, cfg), config.num_learners)
+        else:
+            self.learner = PPOLearner(self.spec, config)
 
         worker_cls = ray_tpu.remote(RolloutWorker)
         self.workers = [
@@ -164,14 +106,11 @@ class PPO:
                 seed=config.seed + 1 + i)
             for i in range(config.num_rollout_workers)
         ]
-        self.iteration = 0
 
-    def train(self) -> Dict[str, Any]:
-        """One iteration: sync sample → learn → metrics (reference:
-        ``algorithm.py:1309`` training_step)."""
+    def training_step(self) -> Dict[str, Any]:
+        """Sync sample → learn → metrics (reference: ``algorithm.py:1309``)."""
         import ray_tpu
 
-        t0 = time.perf_counter()
         weights = self.learner.get_weights()
         batches = ray_tpu.get(
             [w.sample.remote(weights) for w in self.workers])
@@ -180,48 +119,17 @@ class PPO:
             batch, num_epochs=self.config.num_sgd_epochs,
             minibatch_size=self.config.sgd_minibatch_size,
             rng=self._np_rng)
-        returns: List[float] = []
-        for r in ray_tpu.get(
-                [w.episode_returns.remote() for w in self.workers]):
-            returns.extend(r)
-        dt = time.perf_counter() - t0
-        self.iteration += 1
         return {
-            "training_iteration": self.iteration,
             "timesteps_this_iter": batch.count,
-            "env_steps_per_sec": batch.count / dt,
-            "episode_return_mean": float(np.mean(returns))
-            if returns else None,
+            "episode_return_mean": self._mean_returns_from(batches),
             **learn_metrics,
         }
 
-    def get_weights(self):
-        return self.learner.get_weights()
+    def stop(self) -> None:
+        lg_stop = getattr(self.learner, "stop", None)
+        if lg_stop is not None:
+            lg_stop()
+        super().stop()
 
-    def stop(self):
-        import ray_tpu
 
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
-
-    @classmethod
-    def as_trainable(cls, base_config: PPOConfig,
-                     stop_iters: int = 10) -> Callable:
-        """Function trainable for the Tuner (reference: Algorithm IS a
-        Trainable; here a closure reporting per-iteration metrics)."""
-
-        def trainable(tune_config: Dict[str, Any]):
-            from ray_tpu.train import session
-
-            cfg = dataclasses.replace(base_config, **tune_config)
-            algo = cls(cfg)
-            try:
-                for _ in range(stop_iters):
-                    session.report(algo.train())
-            finally:
-                algo.stop()
-
-        return trainable
+PPOConfig._algo_cls = PPO

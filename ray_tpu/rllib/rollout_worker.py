@@ -15,8 +15,8 @@ import numpy as np
 
 from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
 from ray_tpu.rllib.sample_batch import (
-    ACTIONS, ADVANTAGES, DONES, LOGPS, OBS, RETURNS, REWARDS, SampleBatch,
-    VALUES, compute_gae,
+    ACTIONS, ADVANTAGES, DONES, LOGPS, NEXT_VALUES, OBS, RETURNS, REWARDS,
+    SampleBatch, VALUES, compute_gae,
 )
 
 
@@ -85,7 +85,12 @@ class RolloutWorker:
         dones = np.asarray(done_buf)
         adv, rets = compute_gae(rewards, values, dones, last_value,
                                 self.gamma, self.lam)
-        return SampleBatch({
+        # V(s_{t+1}) sequence for off-policy corrections (V-trace): interior
+        # entries are the next step's behavior value (masked by discount at
+        # episode boundaries), the tail entry is the bootstrap value.
+        next_values = np.append(values[1:], np.float32(last_value))
+        batch = SampleBatch({
+            NEXT_VALUES: next_values.astype(np.float32),
             OBS: np.asarray(obs_buf, np.float32),
             ACTIONS: np.asarray(act_buf, np.int32),
             REWARDS: rewards,
@@ -95,6 +100,11 @@ class RolloutWorker:
             ADVANTAGES: adv.astype(np.float32),
             RETURNS: rets.astype(np.float32),
         })
+        # Piggyback completed-episode returns on the fragment so async
+        # algorithms (IMPALA) never need a separate blocking RPC that
+        # would queue behind the next in-flight sample task.
+        batch.completed_returns = self.episode_returns()
+        return batch
 
     def episode_returns(self) -> list:
         """Completed-episode returns since last call (drained)."""

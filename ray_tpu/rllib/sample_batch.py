@@ -15,6 +15,8 @@ LOGPS = "action_logp"
 VALUES = "values"
 ADVANTAGES = "advantages"
 RETURNS = "value_targets"
+NEXT_VALUES = "next_values"  # V(s_{t+1}) under behavior params; tail entry
+                             # is the fragment's bootstrap value
 
 
 class SampleBatch(dict):

@@ -1,0 +1,67 @@
+"""V-trace off-policy correction (reference: ``rllib/algorithms/impala/``
+vtrace_tf/torch — the IMPALA actor-critic targets from Espeholt et al.
+2018, "IMPALA: Scalable Distributed Deep-RL").
+
+TPU-native: a single ``lax.scan`` over the time axis inside jit — the
+whole correction compiles to one fused XLA loop, no per-step Python.
+Arrays are time-major ``[T]`` (one rollout fragment) or ``[T, B]``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class VTraceReturns(NamedTuple):
+    vs: jax.Array            # V-trace value targets for V(x_t)
+    pg_advantages: jax.Array  # policy-gradient advantages
+
+
+def vtrace(
+    behavior_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    values: jax.Array,
+    next_values: jax.Array,
+    discounts: jax.Array,
+    clip_rho_threshold: float = 1.0,
+    clip_c_threshold: float = 1.0,
+) -> VTraceReturns:
+    """Compute V-trace targets for one time-major sequence.
+
+    Args:
+        behavior_logp: log pi_b(a_t|x_t) under the sampling policy.
+        target_logp: log pi(a_t|x_t) under the learner policy.
+        rewards: r_t.
+        values: V(x_t) under the learner's value head.
+        next_values: V(x_{t+1}); the final entry is the bootstrap value.
+        discounts: gamma * (1 - done_t) — 0 at terminal steps.
+        clip_rho_threshold: rho-bar; bounds the value-target correction
+            (controls the fixed point: rho-bar=inf is on-policy n-step).
+        clip_c_threshold: c-bar; bounds the trace cutting in the backward
+            recursion (controls contraction speed).
+    """
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(rhos, clip_rho_threshold)
+    cs = jnp.minimum(rhos, clip_c_threshold)
+    deltas = clipped_rhos * (rewards + discounts * next_values - values)
+
+    def backward(acc, xs):
+        delta_t, discount_t, c_t = xs
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(deltas[-1]),
+        (deltas, discounts, cs), reverse=True)
+    vs = values + vs_minus_v
+
+    # vs_{t+1}: shift forward; at the sequence end fall back to the
+    # bootstrap value (next_values[-1]).
+    vs_next = jnp.concatenate([vs[1:], next_values[-1:]], axis=0)
+    pg_advantages = clipped_rhos * (rewards + discounts * vs_next - values)
+    return VTraceReturns(vs=jax.lax.stop_gradient(vs),
+                         pg_advantages=jax.lax.stop_gradient(pg_advantages))
