@@ -4,8 +4,10 @@ architecture comment usage_lib.py:20-28).
 
 Privacy-first divergence from the reference: this implementation NEVER
 makes a network call. Stats are aggregated in the GCS KV (``usage`` keys)
-and written to ``usage_stats.json`` in the session temp dir so operators
-can inspect or export them by their own means. Opt out with
+and written at driver disconnect to
+``<tmp>/ray_tpu/usage_stats_<session_name>.json`` (next to — not inside —
+the session dir, which is removed at shutdown) so operators can inspect
+or export them by their own means. Opt out with
 ``RAY_TPU_USAGE_STATS_ENABLED=0``.
 """
 
@@ -90,9 +92,25 @@ def on_driver_connect() -> None:
 
 
 def on_driver_disconnect() -> None:
-    """Write the local usage report at shutdown (the documented artifact)."""
+    """Write the local usage report at shutdown (the documented artifact).
+
+    The local cluster's session dir is rmtree'd moments later in the same
+    shutdown() call, so the report goes NEXT TO it — a per-session filename
+    that survives cleanup and can't be clobbered by concurrent drivers.
+    Remote-cluster drivers (no local session dir) fall back to a per-pid
+    temp file for the same no-clobber reason.
+    """
     try:
-        write_usage_report()
+        from ray_tpu._private import worker as worker_mod
+        cluster = getattr(worker_mod, "_global_cluster", None)
+        session_dir = getattr(cluster, "session_dir", None)
+        if session_dir:
+            path = os.path.join(
+                os.path.dirname(session_dir),
+                f"usage_stats_{os.path.basename(session_dir)}.json")
+        else:
+            path = None
+        write_usage_report(report_path=path)
     except Exception:
         pass
 
@@ -128,15 +146,26 @@ def get_usage_stats() -> Optional[dict]:
         return None
 
 
-def write_usage_report(session_dir: Optional[str] = None) -> Optional[str]:
-    """Write the snapshot to ``usage_stats.json`` (local file, no egress)."""
+def write_usage_report(session_dir: Optional[str] = None,
+                       report_path: Optional[str] = None) -> Optional[str]:
+    """Write the snapshot to a local JSON file (no egress).
+
+    ``report_path`` wins if given; else ``session_dir/usage_stats.json``
+    (mid-run operator export); else a per-pid temp file so concurrent
+    drivers in a shared tmp never clobber each other.
+    """
     if not usage_stats_enabled():
         return None
     stats = get_usage_stats()
     if stats is None:
         return None
-    session_dir = session_dir or os.environ.get("TMPDIR", "/tmp")
-    path = os.path.join(session_dir, "usage_stats.json")
+    if report_path:
+        path = report_path
+    elif session_dir:
+        path = os.path.join(session_dir, "usage_stats.json")
+    else:
+        path = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                            f"usage_stats_{os.getpid()}.json")
     try:
         with open(path, "w") as f:
             json.dump(stats, f, indent=2)

@@ -43,8 +43,10 @@ class A2CLearner(Learner):
             logp = jnp.take_along_axis(
                 logp_all, batch[ACTIONS][:, None].astype(jnp.int32),
                 axis=1)[:, 0]
+            # Advantages arrive pre-normalized over the FULL train batch
+            # (update_from_batch), so microbatched gradient accumulation is
+            # exactly equivalent to a full-batch step.
             adv = batch[ADVANTAGES]
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
             pi_loss = -jnp.mean(logp * adv)
             vf_loss = jnp.mean((values - batch[RETURNS]) ** 2)
             entropy = -jnp.mean(
@@ -57,16 +59,41 @@ class A2CLearner(Learner):
 
     def update_from_batch(self, batch: SampleBatch,
                           microbatch_size: int = 0) -> Dict[str, float]:
+        import numpy as _np
+
+        # Normalize advantages ONCE over the full train batch (not per
+        # microbatch) so the accumulated microbatch gradient equals the
+        # full-batch gradient and microbatch_size is a pure memory knob.
+        adv = _np.asarray(batch[ADVANTAGES], _np.float32)
+        batch = SampleBatch({**dict(batch),
+                             ADVANTAGES: (adv - adv.mean())
+                             / (adv.std() + 1e-8)})
         n = batch.count
         if microbatch_size and microbatch_size < n:
-            # Include the ragged tail so no transition is dropped (one
-            # extra XLA compile for the tail shape, cached thereafter).
-            metrics: Dict[str, float] = {}
+            # Reference semantics (a2c.py training_step): accumulate
+            # gradients over sequential microbatches, then apply ONE
+            # optimizer step per train batch — microbatching bounds peak
+            # memory without changing training dynamics. The ragged tail
+            # is included so no transition is dropped (one extra XLA
+            # compile for the tail shape, cached thereafter).
+            import jax
+
+            acc = None
+            metric_sums: Dict[str, float] = {}
+            total = 0
             for i in range(0, n, microbatch_size):
                 sub = SampleBatch(
                     {k: v[i:i + microbatch_size] for k, v in batch.items()})
-                metrics = self.step(sub)
-            return metrics
+                grads, aux = self.compute_grads(dict(sub))
+                w = sub.count
+                scaled = jax.tree.map(lambda g: w * g, grads)
+                acc = scaled if acc is None else jax.tree.map(
+                    lambda a, b: a + b, acc, scaled)
+                for k, val in aux.items():
+                    metric_sums[k] = metric_sums.get(k, 0.0) + w * val
+                total += w
+            self.apply_grads(jax.tree.map(lambda g: g / total, acc))
+            return {k: s / total for k, s in metric_sums.items()}
         return self.step(batch)
 
 

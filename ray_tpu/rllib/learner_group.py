@@ -109,6 +109,20 @@ class LearnerGroup:
 
         ray_tpu.get([s.set_weights.remote(w) for s in self._shards])
 
+    def get_state(self):
+        """Checkpoint state: shards are replicated, so shard 0 speaks for
+        the group (``Algorithm.save_checkpoint`` calls this)."""
+        import ray_tpu
+
+        return ray_tpu.get(self._shards[0].get_state.remote())
+
+    def set_state(self, state) -> None:
+        """Broadcast restored state to every shard, preserving the
+        replication invariant."""
+        import ray_tpu
+
+        ray_tpu.get([s.set_state.remote(state) for s in self._shards])
+
     def stop(self) -> None:
         import ray_tpu
 

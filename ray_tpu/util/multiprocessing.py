@@ -52,11 +52,13 @@ class AsyncResult:
 
     def __init__(self, refs: Sequence[Any], single: bool,
                  callback: Optional[Callable] = None,
-                 error_callback: Optional[Callable] = None):
+                 error_callback: Optional[Callable] = None,
+                 on_done: Optional[Callable] = None):
         self._refs = list(refs)
         self._single = single
         self._callback = callback
         self._error_callback = error_callback
+        self._on_done = on_done
         self._value = None
         self._error: Optional[BaseException] = None
         self._done = threading.Event()
@@ -82,6 +84,11 @@ class AsyncResult:
                     pass
         finally:
             self._done.set()
+            if self._on_done is not None:
+                try:
+                    self._on_done()
+                except Exception:
+                    pass
 
     def ready(self) -> bool:
         return self._done.is_set()
@@ -125,6 +132,10 @@ class Pool:
         self._processes = processes
         self._rr = itertools.cycle(range(processes))
         self._closed = False
+        # Outstanding (not-yet-completed) chunk refs, for join(); keyed by
+        # id() so untrack is O(1) without requiring ref hashability.
+        self._pending: dict = {}
+        self._pending_lock = threading.Lock()
 
     # ------------------------------------------------------------ submit
 
@@ -140,7 +151,20 @@ class Pool:
         for chunk in _chunks(items, chunksize):
             actor = self._actors[next(self._rr)]
             refs.append(actor.run_chunk.remote(fn, chunk, star))
+        self._track(refs)
         return refs
+
+    def _track(self, refs: List[Any]) -> None:
+        with self._pending_lock:
+            for r in refs:
+                self._pending[id(r)] = r
+
+    def _untrack(self, refs: List[Any]) -> None:
+        """Drop completed refs promptly so the Pool never pins finished
+        results in the object store (they stay only until consumed)."""
+        with self._pending_lock:
+            for r in refs:
+                self._pending.pop(id(r), None)
 
     def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
         return self.apply_async(fn, args, kwds).get()
@@ -154,8 +178,10 @@ class Pool:
         actor = self._actors[next(self._rr)]
         call = (lambda a: fn(*a, **kwds))
         ref = actor.run_chunk.remote(call, [args], False)
+        self._track([ref])
         return AsyncResult([ref], single=True, callback=callback,
-                           error_callback=error_callback)
+                           error_callback=error_callback,
+                           on_done=lambda: self._untrack([ref]))
 
     def map(self, fn: Callable, iterable: Iterable[Any],
             chunksize: Optional[int] = None) -> List[Any]:
@@ -169,7 +195,8 @@ class Pool:
         items = list(iterable)
         refs = self._submit_chunks(fn, items, chunksize, star=False)
         return AsyncResult(refs, single=False, callback=callback,
-                           error_callback=error_callback)
+                           error_callback=error_callback,
+                           on_done=lambda: self._untrack(refs))
 
     def starmap(self, fn: Callable, iterable: Iterable[tuple],
                 chunksize: Optional[int] = None) -> List[Any]:
@@ -183,27 +210,47 @@ class Pool:
         items = [tuple(x) for x in iterable]
         refs = self._submit_chunks(fn, items, chunksize, star=True)
         return AsyncResult(refs, single=False, callback=callback,
-                           error_callback=error_callback)
+                           error_callback=error_callback,
+                           on_done=lambda: self._untrack(refs))
 
     def imap(self, fn: Callable, iterable: Iterable[Any],
              chunksize: int = 1):
+        # Submit eagerly (stdlib semantics: work starts at the imap call,
+        # and join() sees it even if the iterator is never consumed); only
+        # result consumption is lazy.
         self._check_open()
         items = list(iterable)
         refs = self._submit_chunks(fn, items, chunksize, star=False)
-        for ref in refs:
-            for v in ray_tpu.get(ref):
-                yield v
+
+        def _gen():
+            for ref in refs:
+                try:
+                    vals = ray_tpu.get(ref)
+                finally:
+                    # Untrack even on task error: the ref is consumed either
+                    # way, and a long-lived pool must not pin failed chunks.
+                    self._untrack([ref])
+                for v in vals:
+                    yield v
+        return _gen()
 
     def imap_unordered(self, fn: Callable, iterable: Iterable[Any],
                        chunksize: int = 1):
         self._check_open()
         items = list(iterable)
         refs = self._submit_chunks(fn, items, chunksize, star=False)
-        pending = list(refs)
-        while pending:
-            ready, pending = ray_tpu.wait(pending, num_returns=1)
-            for v in ray_tpu.get(ready[0]):
-                yield v
+
+        def _gen():
+            pending = list(refs)
+            while pending:
+                ready, pending = ray_tpu.wait(pending, num_returns=1)
+                try:
+                    vals = ray_tpu.get(ready[0])
+                finally:
+                    self._untrack(ready)
+                for v in vals:
+                    yield v
+        return _gen()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -218,10 +265,23 @@ class Pool:
             except Exception:
                 pass
         self._actors = []
+        with self._pending_lock:
+            self._pending = {}   # killed work never completes; stop pinning
 
     def join(self):
+        """Block until all submitted work has completed (stdlib semantics:
+        join after close waits for outstanding tasks to drain)."""
         if not self._closed:
             raise ValueError("Pool is still running")
+        with self._pending_lock:
+            pending = list(self._pending.values())
+        if pending:
+            # Tasks may fail; join only waits for completion, it does not
+            # re-raise (errors surface on the AsyncResult.get). Untrack
+            # only AFTER a successful wait so a failed/interrupted join can
+            # be retried without falsely reporting the pool drained.
+            ray_tpu.wait(pending, num_returns=len(pending))
+            self._untrack(pending)
 
     def __enter__(self):
         return self

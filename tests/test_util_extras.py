@@ -78,6 +78,44 @@ def test_pool_lifecycle(ray_start_regular):
         p.map(_sq, [1])
 
 
+def test_pool_join_waits_for_outstanding(ray_start_regular):
+    """ADVICE r3: join() after close() must block until submitted work
+    completes (stdlib semantics), not return immediately."""
+    import time
+
+    def slow(x):
+        time.sleep(0.5)
+        return x
+
+    p = Pool(2)
+    res = p.apply_async(slow, (1,))
+    assert p._pending  # tracked while outstanding
+    p.close()
+    t0 = time.monotonic()
+    p.join()
+    assert time.monotonic() - t0 > 0.2  # actually waited
+    assert res.get(timeout=5) == 1
+    p.terminate()
+
+    # Completed results are untracked by the AsyncResult collector itself
+    # (no join involved), so a long-lived pool never pins dead results.
+    p2 = Pool(1)
+    r2 = p2.apply_async(slow, (2,))
+    assert r2.get(timeout=5) == 2
+    deadline = time.monotonic() + 5
+    while p2._pending and time.monotonic() < deadline:
+        time.sleep(0.02)  # collector thread calls on_done after get()
+    assert not p2._pending
+    # imap submits eagerly: un-iterated work is still visible to join().
+    it = p2.imap(slow, [1, 2])
+    assert p2._pending
+    p2.close()
+    p2.join()
+    assert list(it) == [1, 2]
+    p2.terminate()
+    assert not p2._pending  # terminate drops dead work
+
+
 def test_check_serialize_ok():
     ok, failures = inspect_serializability(lambda x: x + 1,
                                            print_failures=False)
