@@ -145,6 +145,23 @@ _d("max_lineage_reconstructions", 3,
    "Times a lost object may be rebuilt by re-running its producing task "
    "(reference: object_recovery_manager.h:41 + task_manager resubmit).")
 
+# --- direct task transport (worker leases) ---------------------------------
+_d("lease_enabled", True,
+   "Stream same-shape tasks directly to leased workers, bypassing the "
+   "GCS scheduler on the hot path (reference: "
+   "core_worker/transport/direct_task_transport.h:75 lease reuse).")
+_d("lease_pipeline_depth", 10,
+   "Tasks in flight per leased worker before queueing at the caller "
+   "(reference: max_tasks_in_flight_per_worker, direct_task_transport).")
+_d("lease_idle_timeout_s", 2.0,
+   "A leased worker idle this long is returned to the node's pool and "
+   "its resources released (reference: worker lease idle return).")
+_d("lease_max_workers_per_shape", 16,
+   "Cap on concurrently leased workers per scheduling shape per caller.")
+_d("lease_report_flush_ms", 100,
+   "Batch interval for reporting lease-task completions (object "
+   "locations + lineage specs) to the GCS.")
+
 # --- memory monitor ---------------------------------------------------------
 _d("memory_monitor_refresh_ms", 250,
    "Node memory sampling period; 0 disables the monitor "

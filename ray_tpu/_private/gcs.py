@@ -238,6 +238,12 @@ class GcsServer:
         self._task_specs: Dict[bytes, TaskSpec] = {}
         self._reconstructions: Dict[bytes, int] = {}      # task_id -> attempts
 
+        # Worker leases for the direct task transport (reference:
+        # direct_task_transport.h:75): lease_id -> holder/placement. A
+        # lease holds its shape's resources until returned (or its client
+        # or node dies).
+        self._leases: Dict[bytes, Dict[str, Any]] = {}
+
         # task events ring buffer (reference: gcs_task_manager.h bounded store)
         self._task_events: collections.deque = collections.deque(maxlen=100_000)
 
@@ -444,8 +450,10 @@ class GcsServer:
                 cid = conn.meta.get("client_id")
                 self._clients.pop(cid, None)
                 self._drop_client_refs(cid)
+                self._release_client_leases_locked(cid)
                 if role == "driver":
                     self._on_driver_exit(cid)
+                self._try_schedule()
 
     def _on_driver_exit(self, client_id: str):
         """Kill this driver's non-detached actors (job cleanup)."""
@@ -466,6 +474,11 @@ class GcsServer:
             return
         node.alive = False
         logger.warning("node %s died", node_id)
+        # Leases on the dead node die with it (resources went with the node;
+        # holders notice their direct conns closing and fall back).
+        for lid, lease in list(self._leases.items()):
+            if lease["node_id"] == node_id:
+                self._leases.pop(lid, None)
         # Drop object locations on that node. For objects whose LAST copy
         # just died and that something still wants (live refs, task-arg
         # pins, or parked waiters), re-run the producing task — lineage
@@ -661,23 +674,12 @@ class GcsServer:
     def _h_submit_task(self, conn, spec: TaskSpec, msg_id):
         with self._lock:
             spec.retries_left = spec.max_retries
-            for rid in spec.return_ids():
-                self._producing_task[rid.binary()] = spec.task_id.binary()
             # Retain the spec for lineage reconstruction; pin its args so
             # refcount-zero deps can't be freed out from under it. The
             # table is LRU-bounded: evicting old lineage turns a later
             # reconstruction attempt into a clean ObjectLost error
             # (reference: lineage eviction once refs go out of scope).
-            from ray_tpu._private.config import config as _cfg
-
-            self._task_specs[spec.task_id.binary()] = spec
-            cap = int(_cfg.max_lineage_entries)
-            while len(self._task_specs) > cap:
-                old_tid, old_spec = next(iter(self._task_specs.items()))
-                del self._task_specs[old_tid]
-                self._reconstructions.pop(old_tid, None)
-                for rid in old_spec.return_ids():
-                    self._producing_task.pop(rid.binary(), None)
+            self._retain_spec_locked(spec)
             self._pin_task_args(spec)
             self._enqueue_task(spec)
             self._try_schedule()
@@ -841,6 +843,91 @@ class GcsServer:
             elif entry is not None:
                 self._unpin_task_args(entry[0])
             self._try_schedule()
+
+    # ------------------------------------------------- worker leases
+    # (direct task transport, reference: direct_task_transport.h:75 —
+    # the GCS only brokers leases; leased-task submission/completion
+    # flows caller -> worker directly and is reported back in batches.)
+
+    def _h_request_worker_lease(self, conn, p, msg_id):
+        """Grant (or deny) a worker lease for a scheduling shape.
+
+        A grant acquires the shape's resources on the chosen node until
+        ``return_lease``. Denial (None reply) means no capacity now; the
+        caller falls back to the classic scheduled path.
+        """
+        import os as _os
+
+        with self._lock:
+            resources = p["resources"]
+            node = self._pick_node(resources, None,
+                                   preferred=p.get("owner_node"))
+            if node is None or not node.available.acquire(resources):
+                conn.reply(msg_id, None)
+                return
+            lease_id = _os.urandom(16)
+            self._leases[lease_id] = {
+                "client_id": p["client_id"],
+                "node_id": node.node_id,
+                "resources": dict(resources),
+            }
+            conn.reply(msg_id, {
+                "lease_id": lease_id,
+                "node_id": node.node_id,
+                "node_address": node.address,
+            })
+
+    def _h_return_lease(self, conn, p, msg_id):
+        with self._lock:
+            self._release_lease_locked(p["lease_id"])
+            self._try_schedule()
+
+    def _release_lease_locked(self, lease_id: bytes):
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        node = self._nodes.get(lease["node_id"])
+        if node is not None and node.alive:
+            node.available.release(lease["resources"])
+
+    def _release_client_leases_locked(self, client_id: str):
+        for lid, lease in list(self._leases.items()):
+            if lease["client_id"] == client_id:
+                self._release_lease_locked(lid)
+
+    def _retain_spec_locked(self, spec: TaskSpec):
+        """Retain a spec for lineage reconstruction (LRU-bounded)."""
+        from ray_tpu._private.config import config as _cfg
+
+        for rid in spec.return_ids():
+            self._producing_task[rid.binary()] = spec.task_id.binary()
+        self._task_specs[spec.task_id.binary()] = spec
+        cap = int(_cfg.max_lineage_entries)
+        while len(self._task_specs) > cap:
+            old_tid, old_spec = next(iter(self._task_specs.items()))
+            del self._task_specs[old_tid]
+            self._reconstructions.pop(old_tid, None)
+            for rid in old_spec.return_ids():
+                self._producing_task.pop(rid.binary(), None)
+
+    def _h_lease_task_events(self, conn, p, msg_id):
+        """Batched completion report for lease-path tasks: registers
+        object locations (so other clients' get/wait resolve) and retains
+        specs for lineage — the deferred, amortized equivalent of what
+        submit_task + task_done do synchronously on the classic path."""
+        node_id = p["node_id"]
+        with self._lock:
+            for t in p["tasks"]:
+                spec = t.get("spec")
+                if spec is not None:
+                    # Lease specs never went through _h_submit_task, so
+                    # arm the retry budget here: a later reconstruction
+                    # re-run gets the same retries the classic path would.
+                    if getattr(spec, "retries_left", None) in (None, 0):
+                        spec.retries_left = spec.max_retries
+                    self._retain_spec_locked(spec)
+                for oid, size in t.get("objects", ()):
+                    self._add_location(oid, node_id, size)
 
     def _handle_task_failure(self, spec: TaskSpec, reason: str):
         """System failure (worker/node death): retry or store error objects."""

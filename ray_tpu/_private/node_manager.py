@@ -47,6 +47,7 @@ IDLE = "idle"
 BUSY = "busy"
 STARTING = "starting"
 ACTOR = "actor"
+LEASED = "leased"   # checked out to a caller's direct task transport
 
 
 @dataclass
@@ -68,6 +69,9 @@ class WorkerHandle:
     logs_done: bool = False        # dead + fully drained
     busy_since: float = 0.0        # when the current task started
     death_reason: str = ""         # e.g. set by the memory monitor
+    direct_address: Optional[str] = None  # worker's own task server
+    lease_reply: Optional[tuple] = None   # (conn, msg_id) awaiting register
+    leased_conn: Optional[protocol.Conn] = None  # caller conn holding lease
 
 
 class NodeManager:
@@ -508,6 +512,13 @@ class NodeManager:
     def _on_server_disconnect(self, conn: protocol.Conn):
         wid = conn.meta.get("worker_id")
         if wid is None:
+            # A caller conn: reclaim any workers it was leasing (safety net
+            # for callers that died before ever dialing the worker).
+            with self._lock:
+                leased = [w for w in self._workers.values()
+                          if w.leased_conn is conn]
+            for w in leased:
+                self._release_leased_worker(w)
             return
         with self._lock:
             w = self._workers.get(wid)
@@ -528,6 +539,15 @@ class NodeManager:
             tasks = dict(w.current_tasks)
             w.current_tasks.clear()
             actor_id = w.actor_id
+            lease_reply, w.lease_reply = w.lease_reply, None
+        if lease_reply is not None:
+            # Died before registering: tell the waiting lease caller so it
+            # can fall back to the scheduled path.
+            lconn, lmsg_id = lease_reply
+            try:
+                lconn.reply_error(lmsg_id, "leased worker died at startup")
+            except protocol.ConnectionClosed:
+                pass
         # Fail in-flight tasks. Plain tasks: report crashed WITHOUT
         # materializing error objects — the GCS owns the retry budget, and
         # an early error object would fulfill the caller's get() with the
@@ -945,6 +965,16 @@ class NodeManager:
                     if w is not None:
                         w.killed_by_us = True
                         w.no_restart_kill = True
+            elif mtype == "lease_worker":
+                self._on_lease_worker(conn, payload, msg_id)
+            elif mtype == "lease_released":
+                # From the leased worker itself: its caller's direct conn
+                # closed (lease returned or caller died) — back to the pool.
+                wid_rel = conn.meta.get("worker_id")
+                with self._lock:
+                    w_rel = self._workers.get(wid_rel)
+                if w_rel is not None:
+                    self._release_leased_worker(w_rel)
             elif mtype == "submit_actor_task":
                 # Ack after the spec is parked with the actor's worker (or
                 # handed to GCS for reroute) — from then on the worker-death
@@ -971,27 +1001,77 @@ class NodeManager:
 
     def _on_register_worker(self, conn, p, msg_id):
         wid = p["worker_id"]
+        lease_reply = None
         with self._lock:
             w = self._workers.get(wid)
             if w is None:
                 conn.reply_error(msg_id, "unknown worker")
                 return
             w.conn = conn
+            w.direct_address = p.get("direct_address")
             conn.meta["worker_id"] = wid
             pushes, w.pending_pushes = w.pending_pushes, []
             if w.state == STARTING:
-                if w.dedicated:
+                if w.lease_reply is not None:
+                    # Spawned to satisfy a pending lease: hand it to the
+                    # waiting caller now that its direct address is known.
+                    lease_reply, w.lease_reply = w.lease_reply, None
+                    w.state = LEASED
+                elif w.dedicated:
                     w.state = BUSY
                 else:
                     w.state = IDLE
                     self._idle.append(w)
         conn.reply(msg_id, {"node_id": self.node_id})
+        if lease_reply is not None:
+            lconn, lmsg_id = lease_reply
+            try:
+                lconn.reply(lmsg_id, {"worker_id": wid,
+                                      "direct_address": w.direct_address})
+            except protocol.ConnectionClosed:
+                self._release_leased_worker(w)
         for mtype, payload in pushes:
             try:
                 conn.notify(mtype, payload)
             except protocol.ConnectionClosed:
                 self._on_worker_death(w)
                 return
+        self._dispatch_queued()
+
+    def _on_lease_worker(self, conn, p, msg_id):
+        """Check a pooled worker out to a caller's direct task transport
+        (reference: raylet lease grant, node_manager.h:508). The GCS has
+        already acquired the lease's resources; here we only provide the
+        process. Replies with the worker's own task-server address; if a
+        fresh worker must spawn, the reply is deferred to registration."""
+        with self._lock:
+            w = None
+            while self._idle:
+                cand = self._idle.pop()
+                if cand.state == IDLE and cand.conn is not None \
+                        and not cand.conn.closed \
+                        and cand.direct_address is not None:
+                    w = cand
+                    break
+            if w is not None:
+                w.state = LEASED
+                w.leased_conn = conn
+        if w is not None:
+            conn.reply(msg_id, {"worker_id": w.worker_id,
+                                "direct_address": w.direct_address})
+            return
+        w = self._spawn_worker()
+        with self._lock:
+            w.lease_reply = (conn, msg_id)
+            w.leased_conn = conn
+
+    def _release_leased_worker(self, w: WorkerHandle):
+        with self._lock:
+            if w.state != LEASED or w.worker_id not in self._workers:
+                return
+            w.state = IDLE
+            w.leased_conn = None
+            self._idle.append(w)
         self._dispatch_queued()
 
     def _on_task_done(self, conn, p):

@@ -321,6 +321,14 @@ class CoreWorker:
 
         if _cfg.refcount_enabled:
             self._refs = _RefTracker(self)
+        # Direct task transport (reference: direct_task_transport.h:75):
+        # same-shape tasks stream straight to leased workers after the
+        # first lease, bypassing the GCS scheduler on the hot path.
+        self._lease_mgr = None
+        if _cfg.lease_enabled:
+            from ray_tpu._private.lease import LeaseManager
+
+            self._lease_mgr = LeaseManager(self)
 
     def _route_submit(self, fn, *args):
         try:
@@ -397,6 +405,12 @@ class CoreWorker:
         if self._closed:
             return
         self._closed = True
+        if self._lease_mgr is not None:
+            try:
+                self._lease_mgr.close()
+            except Exception:
+                pass
+            self._lease_mgr = None
         if self._refs is not None:
             self._refs.stop()
             self._refs = None
@@ -487,6 +501,9 @@ class CoreWorker:
         if not missing:
             return failures
         deadline = time.time() + timeout if timeout is not None else None
+        missing = self._wait_lease_local(missing, deadline)
+        if not missing:
+            return failures
         pending = set(missing)
         while pending:
             t = None
@@ -518,6 +535,54 @@ class CoreWorker:
                 if still_missing:
                     time.sleep(0.05)
         return failures
+
+    def _wait_lease_local(self, missing: List[bytes],
+                          deadline: Optional[float]) -> List[bytes]:
+        """Resolve objects produced by our own in-flight lease tasks
+        without touching the GCS: wait on the local completion event,
+        then read the local store (same node) or fetch from the producing
+        node directly. Returns the ids that still need the GCS path."""
+        lm = self._lease_mgr
+        if lm is None:
+            return missing
+        rest: List[bytes] = []
+        for oid in missing:
+            ent = lm.peek(oid)
+            if ent is None:
+                rest.append(oid)
+                continue
+            t = None if deadline is None else max(0.0,
+                                                  deadline - time.time())
+            if not ent["ev"].wait(t):
+                raise exceptions.GetTimeoutError(
+                    "object not ready within timeout")
+            info = ent.get("info")
+            if info is None:          # task fell back to the scheduled path
+                rest.append(oid)
+                continue
+            if self.store.contains(oid):
+                continue
+            node_id, nm_address, _size = info
+            if node_id != self.node_id and \
+                    self._fetch_from(nm_address, oid):
+                continue
+            rest.append(oid)          # evicted/spilled etc: GCS path
+        return rest
+
+    def _fetch_from(self, address: str, oid: bytes) -> bool:
+        """Pull one object from a known holder node into the local store."""
+        try:
+            data = self.nm_conn(address).request(
+                "fetch_object", {"object_id": oid}, timeout=60)
+        except (protocol.ConnectionClosed, protocol.RemoteCallError,
+                TimeoutError, OSError):
+            return False
+        if data is None:
+            return False
+        self._store_local(oid, data)
+        self.gcs.notify("add_object_locations", {
+            "node_id": self.node_id, "objects": [(oid, len(data))]})
+        return True
 
     def _pull_objects(self, id_bytes_list: List[bytes]) -> None:
         """Fetch objects that are ready somewhere into the local store."""
@@ -601,6 +666,14 @@ class CoreWorker:
         ids = [r.binary() for r in refs]
         local = {o for o in ids if self.store.contains(o)}
         ready_set = set(local)
+        if self._lease_mgr is not None and len(ready_set) < num_returns:
+            # Completed-but-not-yet-flushed lease tasks are ready too.
+            for o in ids:
+                if o not in ready_set:
+                    ent = self._lease_mgr.peek(o)
+                    if ent is not None and ent["ev"].is_set() \
+                            and ent.get("info") is not None:
+                        ready_set.add(o)
         if len(ready_set) < num_returns:
             reply = self.gcs.request("wait_for_objects", {
                 "object_ids": [o for o in ids if o not in ready_set],
@@ -628,7 +701,16 @@ class CoreWorker:
 
     # ---------------------------------------------------------------- tasks
 
+    _EMPTY_ARGS_BLOB: Optional[bytes] = None
+
     def _serialize_args(self, args, kwargs) -> Tuple[Any, List[ObjectID]]:
+        if not args and not kwargs:
+            # Zero-arg calls are common on the hot path; reuse one blob.
+            blob = CoreWorker._EMPTY_ARGS_BLOB
+            if blob is None:
+                blob = serialization.serialize(((), {})).to_bytes()
+                CoreWorker._EMPTY_ARGS_BLOB = blob
+            return blob, []
         deps: List[ObjectID] = []
         proc_args = []
         for a in args:
@@ -719,11 +801,21 @@ class CoreWorker:
             placement_group_bundle_index=placement_group_bundle_index,
             runtime_env=runtime_env,
         )
-        self.gcs.notify("submit_task", spec)
+        # Direct transport first: plain tasks stream to a leased worker
+        # (submit() declines when closed/over capacity -> scheduled path).
+        lm = self._lease_mgr
+        if not (lm is not None
+                and lm.eligible(resources, scheduling_strategy,
+                                placement_group, runtime_env)
+                and lm.submit(spec)):
+            self.gcs.notify("submit_task", spec)
         return [ObjectRef(oid) for oid in spec.return_ids()]
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True):
+        if self._lease_mgr is not None and \
+                self._lease_mgr.cancel(ref.task_id().binary()):
+            return
         self.gcs.request("cancel_task", {
             "task_id": ref.task_id().binary(), "force": force})
 

@@ -60,11 +60,28 @@ class WorkerExecutor:
 
         signal.signal(signal.SIGUSR1, self._on_cancel_signal)
 
+        self._lease_results: list = []
+        self._lease_results_lock = threading.Lock()
+        self._event_buf: list = []
+        self._event_lock = threading.Lock()
+        self._event_stop = threading.Event()
+        threading.Thread(target=self._event_flush_loop, daemon=True,
+                         name="rtpu-task-events").start()
+
+        # Direct task server: callers holding a lease on this worker stream
+        # tasks here, bypassing GCS + node manager on the hot path
+        # (reference: the core worker's gRPC task service,
+        # direct_task_transport.h:75 / core_worker.h PushTask).
+        self.direct = protocol.Server(self._on_direct_msg,
+                                      name="worker-direct")
+        self.direct.on_disconnect = self._on_direct_disconnect
+
         self.nm = protocol.connect(nm_address, handler=self._on_msg,
                                    name="worker-nm")
         self.nm.on_close = lambda conn: self._on_nm_closed()
         reply = self.nm.request("register_worker", {
-            "worker_id": worker_id, "pid": os.getpid()})
+            "worker_id": worker_id, "pid": os.getpid(),
+            "direct_address": self.direct.address})
         self.node_id = reply["node_id"]
 
     # ------------------------------------------------------------- plumbing
@@ -94,6 +111,27 @@ class WorkerExecutor:
             self._queue.append((mtype, payload))
             self._cv.notify()
 
+    def _on_direct_msg(self, conn, mtype, payload, msg_id):
+        if mtype == "lease_run_tasks":
+            # A batch of specs from the lease holder; results flow back in
+            # batched "lease_tasks_done" notifies (amortizing per-message
+            # cost both ways — reference: direct transport pipelining).
+            with self._cv:
+                for spec in payload:
+                    self._queue.append(("lease_task", (spec, conn)))
+                self._cv.notify()
+        elif mtype == "cancel_task":
+            self._handle_cancel(payload["task_id"])
+        elif mtype == "ping":
+            conn.reply(msg_id, True)
+
+    def _on_direct_disconnect(self, conn):
+        # The lease holder hung up: hand this worker back to the pool.
+        try:
+            self.nm.notify("lease_released", None)
+        except protocol.ConnectionClosed:
+            os._exit(0)
+
     def _handle_cancel(self, task_id: bytes):
         with self._cv:
             for item in list(self._queue):
@@ -105,6 +143,18 @@ class WorkerExecutor:
                         payload, exceptions.TaskCancelledError(
                             task_id.hex()))
                     self._task_done(payload, "error", [], "cancelled")
+                    return
+                if mtype == "lease_task" and \
+                        payload[0].task_id.binary() == task_id:
+                    self._queue.remove(item)
+                    spec, lconn = payload
+                    objects = self._store_error_returns(
+                        spec, exceptions.TaskCancelledError(task_id.hex()))
+                    self._queue_lease_result(lconn, {
+                        "task_id": task_id,
+                        "status": "error", "objects": objects,
+                        "error": "cancelled", "node_id": self.node_id})
+                    self._flush_lease_results()
                     return
             if self._current_task_id == task_id:
                 self._cancel_requested = task_id
@@ -131,6 +181,8 @@ class WorkerExecutor:
             try:
                 if mtype == "run_task":
                     self._execute_task(payload)
+                elif mtype == "lease_task":
+                    self._execute_lease_task(*payload)
                 elif mtype == "create_actor":
                     self._create_actor(payload)
                 elif mtype == "run_actor_task":
@@ -231,6 +283,56 @@ class WorkerExecutor:
         self._task_done(spec, status, objects, error)
         self._report_event(spec.task_id, spec.name, start, status,
                            kind="task")
+
+    def _execute_lease_task(self, spec: TaskSpec, conn):
+        """Run a direct-transport task; the result is buffered and ships
+        to the caller in a batched "lease_tasks_done" notify (no
+        node-manager/GCS round trip on the hot path; the caller
+        batch-reports completions to the GCS for locations + lineage)."""
+        self._current_task_id = spec.task_id.binary()
+        self._set_ctx(spec)
+        start = time.time()
+        try:
+            fn = self.core.fetch_function(spec.function_key)
+            args, kwargs = self.core.deserialize_args(spec.args)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            objects = self._store_returns(spec, result)
+            status, error = "ok", None
+        except BaseException as e:
+            err = exceptions.RayTaskError.from_exception(
+                spec.name or spec.function_key[:8], e)
+            objects = self._store_error_returns(spec, err)
+            status, error = "error", str(e)
+        finally:
+            self._current_task_id = None
+            self._cancel_requested = None
+        self._queue_lease_result(conn, {
+            "task_id": spec.task_id.binary(), "status": status,
+            "objects": objects, "error": error, "node_id": self.node_id})
+        with self._cv:
+            backlog = len(self._queue)
+        if backlog == 0 or len(self._lease_results) >= 64:
+            self._flush_lease_results()
+        self._report_event(spec.task_id, spec.name, start, status,
+                           kind="task")
+
+    def _queue_lease_result(self, conn, result: dict):
+        with self._lease_results_lock:
+            self._lease_results.append((conn, result))
+
+    def _flush_lease_results(self):
+        with self._lease_results_lock:
+            pending, self._lease_results = self._lease_results, []
+        by_conn: Dict[Any, list] = {}
+        for conn, result in pending:
+            by_conn.setdefault(conn, []).append(result)
+        for conn, results in by_conn.items():
+            try:
+                conn.notify("lease_tasks_done", {"results": results})
+            except protocol.ConnectionClosed:
+                pass  # caller gone; its GCS-side cleanup owns the fallout
 
     def _create_actor(self, spec: ActorCreationSpec):
         self.actor_spec = spec
@@ -402,8 +504,11 @@ class WorkerExecutor:
 
     def _report_event(self, task_id: TaskID, name: str, start: float,
                       status: str, kind: str):
-        try:
-            self.core.gcs.notify("task_events", [{
+        """Buffer the event; a flusher ships batches to the GCS (one
+        notify per flush window, not per task — at 1k+ tasks/s per worker
+        a per-task notify measurably loads the single GCS lock)."""
+        with self._event_lock:
+            self._event_buf.append({
                 "task_id": task_id.hex(),
                 "name": name,
                 "kind": kind,
@@ -413,7 +518,19 @@ class WorkerExecutor:
                 "start": start,
                 "end": time.time(),
                 "status": status,
-            }])
+            })
+
+    def _event_flush_loop(self):
+        while not self._event_stop.wait(0.2):
+            self._flush_events()
+
+    def _flush_events(self):
+        with self._event_lock:
+            batch, self._event_buf = self._event_buf, []
+        if not batch:
+            return
+        try:
+            self.core.gcs.notify("task_events", batch)
         except Exception:
             pass
 
@@ -449,6 +566,8 @@ def main():
     try:
         executor.run()
     finally:
+        executor._event_stop.set()
+        executor._flush_events()
         core.disconnect()
 
 

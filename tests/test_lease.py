@@ -1,0 +1,180 @@
+"""Direct task transport (worker leases) — semantics + failure paths.
+
+Reference behaviors under test: lease reuse and pipelining
+(src/ray/core_worker/transport/direct_task_transport.h:75,307), lease
+return on idle, fallback to the scheduled path on worker death, and the
+GCS-side resource accounting for held leases.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def lease_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _lease_mgr():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker()._lease_mgr
+
+
+def test_lease_reuse_same_worker(lease_cluster):
+    """Sequential same-shape tasks reuse one leased worker (one pid)."""
+    import os as _os  # noqa: F401
+
+    @ray_tpu.remote
+    def pid():
+        import os
+        return os.getpid()
+
+    pids = {ray_tpu.get(pid.remote()) for _ in range(10)}
+    assert len(pids) == 1, pids
+    lm = _lease_mgr()
+    assert lm is not None
+    key = (("CPU", 1.0),)
+    assert key in lm._shapes and len(lm._shapes[key].leases) >= 1
+
+
+def test_lease_results_and_errors(lease_cluster):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    assert ray_tpu.get([sq.remote(i) for i in range(100)]) == \
+        [i * i for i in range(100)]
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("lease boom")
+
+    with pytest.raises(ValueError, match="lease boom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_lease_dep_chain(lease_cluster):
+    """ObjectRef args between lease tasks resolve (and stay pinned)."""
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 11
+
+
+def test_lease_idle_return_releases_resources(lease_cluster):
+    """After the idle timeout, leases are returned and the GCS resource
+    view recovers to full capacity."""
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(20)])
+    lm = _lease_mgr()
+    deadline = time.time() + float(
+        __import__("ray_tpu._private.config",
+                   fromlist=["config"]).config.lease_idle_timeout_s) + 6
+    while time.time() < deadline:
+        if not any(st.leases for st in lm._shapes.values()):
+            break
+        time.sleep(0.2)
+    assert not any(st.leases for st in lm._shapes.values())
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) == 4.0, avail
+
+
+def test_lease_worker_death_falls_back(lease_cluster):
+    """Killing the leased worker mid-task: the spec falls back to the
+    scheduled path and still completes (at-least-once, like task retry)."""
+    @ray_tpu.remote(max_retries=2)
+    def slow_pid(sec):
+        import os
+        import time as _t
+        _t.sleep(sec)
+        return os.getpid()
+
+    # Warm a lease, find its worker pid.
+    pid0 = ray_tpu.get(slow_pid.remote(0.0))
+    ref = slow_pid.remote(3.0)
+    time.sleep(0.5)   # task is now running on the leased worker
+    import os
+    import signal
+    os.kill(pid0, signal.SIGKILL)
+    # The lease conn drops; the spec is resubmitted via the GCS.
+    pid1 = ray_tpu.get(ref, timeout=60)
+    assert pid1 != pid0
+
+
+def test_lease_capacity_denial_falls_back(lease_cluster):
+    """More parallel tasks than CPUs: overflow runs via the scheduled
+    path (lease requests denied at capacity) and everything completes."""
+    @ray_tpu.remote
+    def busy(x):
+        import time as _t
+        _t.sleep(0.1)
+        return x
+
+    out = ray_tpu.get([busy.remote(i) for i in range(40)], timeout=90)
+    assert out == list(range(40))
+
+
+def test_lease_cancel(lease_cluster):
+    @ray_tpu.remote
+    def forever():
+        import time as _t
+        _t.sleep(600)
+
+    ref = forever.remote()
+    time.sleep(0.6)   # let it reach the leased worker
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_lease_objects_visible_to_other_clients(lease_cluster):
+    """Locations flushed to the GCS: an actor (separate process) can get
+    an object produced by the driver's lease task."""
+    @ray_tpu.remote
+    def make():
+        return {"k": 41}
+
+    ref = make.remote()
+
+    @ray_tpu.remote
+    class Reader:
+        def read(self, r):
+            return r["k"] + 1
+
+    reader = Reader.remote()
+    assert ray_tpu.get(reader.read.remote(ref)) == 42
+
+
+def test_lease_disabled_still_works(monkeypatch):
+    """The classic path is intact when leases are off."""
+    monkeypatch.setenv("RAY_TPU_LEASE_ENABLED", "0")
+    from ray_tpu._private.config import config
+    config.set("lease_enabled", False)
+    try:
+        ctx = ray_tpu.init(num_cpus=2,
+                           object_store_memory=64 * 1024 * 1024)
+
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        assert ray_tpu.get([sq.remote(i) for i in range(10)]) == \
+            [i * i for i in range(10)]
+        from ray_tpu._private import worker as worker_mod
+        assert worker_mod.global_worker()._lease_mgr is None
+    finally:
+        ray_tpu.shutdown()
+        config.set("lease_enabled", True)
