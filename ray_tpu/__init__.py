@@ -24,6 +24,7 @@ from ray_tpu.api import (  # noqa: F401
     get_actor,
     method,
     ObjectRef,
+    ObjectRefGenerator,
     get_runtime_context,
     available_resources,
     cluster_resources,
@@ -34,6 +35,7 @@ from ray_tpu.api import (  # noqa: F401
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "cancel", "kill", "get_actor", "method", "ObjectRef",
+    "ObjectRefGenerator",
     "get_runtime_context", "available_resources", "cluster_resources",
     "nodes", "timeline", "exceptions", "__version__",
 ]

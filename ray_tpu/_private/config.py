@@ -100,6 +100,11 @@ _d("get_timeout_poll_ms", 20, "Poll interval for blocking gets.")
 _d("fetch_chunk_bytes", 5 * 1024 * 1024,
    "Chunk size for node-to-node object transfer (reference uses 5 MiB, "
    "object_manager.proto / ray_config_def.h:332).")
+_d("pull_max_inflight_chunks", 8,
+   "Admission control: chunks in flight per pulling process across ALL "
+   "concurrent pulls (reference: pull_manager.h:52 bounded pull quota). "
+   "Bounds heap use to chunks * fetch_chunk_bytes on top of the arena "
+   "allocation.")
 
 # --- object store -----------------------------------------------------------
 _d("object_store_memory", 2 * 1024 * 1024 * 1024,

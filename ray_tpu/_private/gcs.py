@@ -241,8 +241,14 @@ class GcsServer:
         # Worker leases for the direct task transport (reference:
         # direct_task_transport.h:75): lease_id -> holder/placement. A
         # lease holds its shape's resources until returned (or its client
-        # or node dies).
+        # or node dies, or the GCS revokes it for classic-queue fairness).
         self._leases: Dict[bytes, Dict[str, Any]] = {}
+        self._last_lease_revoke = 0.0
+        # Capacity-denied lease requests double as autoscaler demand
+        # (the caller's queued lease tasks are otherwise invisible here):
+        # shape key -> (resources, last_denied_ts), TTL'd out of
+        # pending_demand (reference: LoadMetrics pending resource demand).
+        self._lease_demand: Dict[tuple, Tuple[Dict[str, float], float]] = {}
 
         # task events ring buffer (reference: gcs_task_manager.h bounded store)
         self._task_events: collections.deque = collections.deque(maxlen=100_000)
@@ -786,6 +792,7 @@ class GcsServer:
         """
         if not self._nodes:
             return
+        stuck = False
         for key, _q in self._queued_tasks.buckets():
             while True:
                 spec = self._queued_tasks.pop_head(key)
@@ -799,6 +806,7 @@ class GcsServer:
                                             RESTARTING):
                         if not self._schedule_actor(entry):
                             self._queued_tasks.appendleft(spec)
+                            stuck = True
                             break  # this actor can't place now
                     continue
                 if spec.task_id.binary() in self._cancelled_tasks:
@@ -817,6 +825,7 @@ class GcsServer:
                     # Head of this shape can't place -> nothing behind it
                     # in the same shape can either; skip the bucket.
                     self._queued_tasks.appendleft(spec)
+                    stuck = True
                     break
                 self._running_tasks[spec.task_id.binary()] = (spec,
                                                               node.node_id)
@@ -827,6 +836,30 @@ class GcsServer:
                     self._release_for(spec, node.node_id)
                     self._queued_tasks.appendleft(spec)
                     break
+        if stuck:
+            self._maybe_revoke_lease_locked()
+
+    def _maybe_revoke_lease_locked(self):
+        """Classic-queue fairness: when scheduled work cannot place while
+        worker leases hold capacity, revoke one lease (rate-limited).
+        The holder's in-flight specs fall back to the scheduled path; a
+        brief oversubscription window (worker finishing its current task
+        after the resources are freed) is accepted, as on the classic
+        force-kill paths."""
+        if not self._leases:
+            return
+        now = time.time()
+        if now - self._last_lease_revoke < 0.2:
+            return
+        self._last_lease_revoke = now
+        lid, lease = next(iter(self._leases.items()))
+        conn = self._clients.get(lease["client_id"])
+        self._release_lease_locked(lid)
+        if conn is not None:
+            try:
+                conn.notify("revoke_lease", {"lease_id": lid})
+            except Exception:
+                pass
 
     def _h_task_done(self, conn, p, msg_id):
         """Node manager reports task completion (success or failure)."""
@@ -838,6 +871,12 @@ class GcsServer:
                 self._release_for(spec, node_id)
             for oid, size in p.get("objects", []):
                 self._add_location(oid, p["node_id"], size)
+            if entry is not None and \
+                    getattr(entry[0], "num_returns", None) == "dynamic":
+                # Dynamic yields are reconstructable: re-running the
+                # generator re-stores every index idempotently.
+                for oid, _size in p.get("objects", []):
+                    self._producing_task[oid] = tid
             if p["status"] == "crashed" and entry is not None:
                 self._handle_task_failure(entry[0], p.get("error", "worker died"))
             elif entry is not None:
@@ -860,9 +899,19 @@ class GcsServer:
 
         with self._lock:
             resources = p["resources"]
+            # Fairness: while classic-path work (tasks, actor creations)
+            # is queued, leases may not grab more capacity — the classic
+            # queue drains first (see also _maybe_revoke_lease_locked).
+            if len(self._queued_tasks) > 0:
+                conn.reply(msg_id, None)
+                return
             node = self._pick_node(resources, None,
                                    preferred=p.get("owner_node"))
             if node is None or not node.available.acquire(resources):
+                shape = tuple(sorted(resources.items()))
+                self._lease_demand[shape] = (
+                    dict(resources), time.time(),
+                    max(1, int(p.get("backlog", 1))))
                 conn.reply(msg_id, None)
                 return
             lease_id = _os.urandom(16)
@@ -928,6 +977,11 @@ class GcsServer:
                     self._retain_spec_locked(spec)
                 for oid, size in t.get("objects", ()):
                     self._add_location(oid, node_id, size)
+                if spec is not None and \
+                        getattr(spec, "num_returns", None) == "dynamic":
+                    for oid, _size in t.get("objects", ()):
+                        self._producing_task[oid] = \
+                            spec.task_id.binary()
 
     def _handle_task_failure(self, spec: TaskSpec, reason: str):
         """System failure (worker/node death): retry or store error objects."""
@@ -1238,6 +1292,17 @@ class GcsServer:
                     or (db not in self._obj_locations
                         and db in self._producing_task)):
                 self._try_reconstruct(db, depth + 1)
+        # A hard affinity to a node that no longer exists would wedge the
+        # rebuild forever; recovering the data beats honoring a placement
+        # hint whose target is gone.
+        strat = spec.scheduling_strategy
+        if getattr(strat, "kind", None) == "node_affinity":
+            n = self._nodes.get(strat.node_id)
+            if n is None or not n.alive:
+                logger.info("reconstruction of %s: dropping affinity to "
+                            "dead node %s", getattr(spec, "name", ""),
+                            strat.node_id[:12])
+                spec.scheduling_strategy = None
         self._pin_task_args(spec)
         self._enqueue_task(spec)
         return True
@@ -1792,6 +1857,13 @@ class GcsServer:
                     r = getattr(entry.spec, "resources", None)
                     if r:
                         demand.append(dict(r))
+            now = time.time()
+            for shape, (res, ts, count) in list(
+                    self._lease_demand.items()):
+                if now - ts > 5.0:
+                    del self._lease_demand[shape]
+                else:
+                    demand.extend(dict(res) for _ in range(count))
             pg_demand: List[List[Dict[str, float]]] = []
             for e in self._pgs.values():
                 if e.state == "PENDING":

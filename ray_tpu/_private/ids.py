@@ -41,6 +41,16 @@ def _rand_bytes(n: int) -> bytes:
         return out
 
 
+def _discard_entropy_after_fork() -> None:
+    # A forked child must not replay the parent's buffered entropy —
+    # identical ID streams would collide across the two processes.
+    global _entropy_off
+    _entropy_off = len(_entropy)
+
+
+os.register_at_fork(after_in_child=_discard_entropy_after_fork)
+
+
 JOB_ID_SIZE = 4
 ACTOR_ID_SIZE = 16
 TASK_ID_SIZE = 24

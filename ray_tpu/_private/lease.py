@@ -80,6 +80,10 @@ class LeaseManager:
         # oid bytes -> {"ev": Event, "info": (node_id, nm_addr, size)|None}
         self._inflight: Dict[bytes, Dict[str, Any]] = {}
         self._task_lease: Dict[bytes, Tuple[_Lease, Any]] = {}
+        self._cancelled: set = set()   # force-cancelled tids: never resubmit
+        # worker_id -> system kill reason (e.g. OOM), pushed by the NM just
+        # before it kills a leased worker; consumed by the failure path.
+        self._kill_reasons: Dict[bytes, str] = {}
         self._reports: List[dict] = []
         self._depth = max(1, int(config.lease_pipeline_depth))
         self._max_per_shape = max(1, int(config.lease_max_workers_per_shape))
@@ -183,11 +187,14 @@ class LeaseManager:
     # ------------------------------------------------------ lease acquire
 
     def _request_lease(self, key: tuple):
+        st = self._shapes.get(key)
+        backlog = len(st.queue) if st is not None else 1
         try:
             fut = self._w.gcs.request_nowait("request_worker_lease", {
                 "client_id": self._w.client_id,
                 "resources": dict(key),
                 "owner_node": self._w.node_id,
+                "backlog": max(1, backlog),
             })
         except BaseException:
             self._lease_denied(key)
@@ -219,11 +226,19 @@ class LeaseManager:
 
         try:
             nm = self._w.nm_conn(grant["node_address"])
-            rep = nm.request("lease_worker", {"resources": dict(key)},
-                             timeout=self._worker_timeout)
+            rep = nm.request("lease_worker", {
+                "resources": dict(key), "lease_id": grant["lease_id"]},
+                timeout=self._worker_timeout)
             conn = protocol.connect(rep["direct_address"], handler=on_msg,
                                     name="lease-direct")
         except BaseException:
+            # Tell the NM the lease is dead too, so a worker that is still
+            # spawning for it is not stranded in LEASED forever.
+            try:
+                self._w.nm_conn(grant["node_address"]).notify(
+                    "abandon_lease", {"lease_id": grant["lease_id"]})
+            except Exception:
+                pass
             try:
                 self._w.gcs.notify("return_lease",
                                    {"lease_id": grant["lease_id"]})
@@ -318,9 +333,15 @@ class LeaseManager:
             self._send(lease, drained)
 
     def _fail_specs(self, lease: _Lease, specs: List[Any]):
-        """Transport failure for specific in-flight specs: resubmit them
-        through the scheduled path, which owns retries and error
-        materialization."""
+        """Transport failure (worker/node death) for in-flight specs.
+
+        Mirrors the classic worker-death path's retry semantics: each
+        failure consumes one unit of the task's retry budget; with budget
+        left the spec resubmits through the scheduled path, otherwise its
+        returns materialize as WorkerCrashedError — a max_retries=0 task
+        is NEVER silently re-executed."""
+        from ray_tpu import exceptions as exc
+
         failed = []
         with self._lock:
             lease.dead = True
@@ -335,8 +356,53 @@ class LeaseManager:
                         ent["ev"].set()   # info None -> GCS path
                 failed.append(spec)
         for spec in failed:
-            self._fallback(spec)   # fallback releases the submit-time pin
+            if spec.task_id.binary() in self._cancelled:
+                self._cancelled.discard(spec.task_id.binary())
+                self._materialize_cancelled(spec)
+                self._decref_deps(spec)
+                continue
+            left = getattr(spec, "retries_left", None)
+            if left is None or left == 0:
+                left = spec.max_retries
+            if left <= 0:
+                with self._lock:
+                    why = self._kill_reasons.get(
+                        lease.worker_id, "leased worker lost")
+                self._materialize_error(spec, exc.WorkerCrashedError(
+                    f"worker running {getattr(spec, 'name', '')} died "
+                    f"({why})"))
+                self._decref_deps(spec)
+            else:
+                # Hand the GCS the REMAINING budget (its submit handler
+                # re-arms retries_left from max_retries).
+                spec.max_retries = left - 1
+                spec.retries_left = left - 1
+                self._fallback(spec)  # fallback releases the submit pin
         self._exec_submit(self._drop_lease, lease)
+
+    def _materialize_cancelled(self, spec):
+        from ray_tpu import exceptions as exc
+
+        self._materialize_error(spec, exc.TaskCancelledError(
+            spec.task_id.binary().hex()))
+
+    def _materialize_error(self, spec, error: BaseException):
+        from ray_tpu._private import serialization
+
+        err = serialization.serialize(error)
+        objects = []
+        for rid in spec.return_ids():
+            oid = rid.binary()
+            try:
+                self._w.store.put_serialized(oid, err)
+            except Exception:
+                pass
+            objects.append((oid, err.total_size()))
+        try:
+            self._w.gcs.notify("add_object_locations", {
+                "node_id": self._w.node_id, "objects": objects})
+        except Exception:
+            pass
 
     def _on_lease_conn_closed(self, lease: _Lease):
         # Worker (or its node) died: every in-flight spec on this lease
@@ -368,7 +434,14 @@ class LeaseManager:
                 while st.queue:
                     requeued.append(st.queue.popleft())
         try:
-            lease.conn.close()   # worker notices -> NM returns it to pool
+            lease.conn.close()
+        except Exception:
+            pass
+        # Explicit, authoritative return to the node manager (the worker's
+        # own conn-closed notify is only honored when the holder died).
+        try:
+            self._w.nm_conn(lease.nm_address).notify(
+                "return_leased_worker", {"worker_id": lease.worker_id})
         except Exception:
             pass
         try:
@@ -386,7 +459,28 @@ class LeaseManager:
         with self._lock:
             return self._inflight.get(oid)
 
-    def cancel(self, task_id: bytes) -> bool:
+    def note_worker_killed(self, worker_id, reason: str) -> None:
+        with self._lock:
+            self._kill_reasons[worker_id] = reason
+            if len(self._kill_reasons) > 64:
+                self._kill_reasons.pop(next(iter(self._kill_reasons)))
+
+    def revoke(self, lease_id) -> None:
+        """GCS-initiated revocation (classic-queue fairness): retire the
+        lease; its in-flight specs fall back via the conn-close path."""
+        target = None
+        with self._lock:
+            for st in self._shapes.values():
+                for lease in st.leases:
+                    if lease.lease_id == lease_id:
+                        target = lease
+                        break
+                if target is not None:
+                    break
+        if target is not None:
+            self._exec_submit(self._drop_lease, target)
+
+    def cancel(self, task_id: bytes, force: bool = False) -> bool:
         queued_spec = None
         with self._lock:
             ent = self._task_lease.get(task_id)
@@ -403,29 +497,25 @@ class LeaseManager:
         if queued_spec is not None:
             # Materialize cancelled-error returns locally so the owner's
             # get() resolves immediately (mirrors the worker's queue-cancel).
-            from ray_tpu import exceptions as exc
-            from ray_tpu._private import serialization
-
-            err = serialization.serialize(
-                exc.TaskCancelledError(task_id.hex()))
-            objects = []
-            for rid in queued_spec.return_ids():
-                oid = rid.binary()
-                try:
-                    self._w.store.put_serialized(oid, err)
-                except Exception:
-                    pass
-                objects.append((oid, err.total_size()))
-            try:
-                self._w.gcs.notify("add_object_locations", {
-                    "node_id": self._w.node_id, "objects": objects})
-            except Exception:
-                pass
+            self._materialize_cancelled(queued_spec)
             self._decref_deps(queued_spec)
             return True
         if ent is None:
             return False
         lease, _spec = ent
+        if force:
+            # Classic force-cancel kills the worker process; match it.
+            # The kill closes the lease conn: other in-flight specs fall
+            # back, while this one (marked cancelled) materializes a
+            # TaskCancelledError instead of resubmitting.
+            self._cancelled.add(task_id)
+            try:
+                self._w.nm_conn(lease.nm_address).notify(
+                    "kill_leased_worker", {"worker_id": lease.worker_id})
+                return True
+            except Exception:
+                self._cancelled.discard(task_id)
+                return False
         try:
             lease.conn.notify("cancel_task", {"task_id": task_id})
             return True
@@ -439,8 +529,26 @@ class LeaseManager:
             try:
                 self._flush_reports()
                 self._reap_idle()
+                self._retry_backlogged()
             except Exception:
                 pass
+
+    def _retry_backlogged(self):
+        """Shapes with queued work keep asking for capacity: each retry
+        (a) grabs leases the moment the cluster grows — the autoscaler
+        path — and (b) refreshes the GCS's denied-lease demand signal so
+        the autoscaler knows to grow."""
+        now = time.monotonic()
+        with self._lock:
+            if self._closed:
+                return
+            for key, st in self._shapes.items():
+                if (st.queue and now >= st.denied_until
+                        and st.requesting < len(st.queue)
+                        and len(st.leases) + st.requesting
+                        < self._max_per_shape):
+                    st.requesting += 1
+                    self._request_lease(key)
 
     def _flush_reports(self):
         with self._lock:
@@ -499,6 +607,11 @@ class LeaseManager:
         for lease in leases:
             try:
                 lease.conn.close()
+            except Exception:
+                pass
+            try:
+                self._w.nm_conn(lease.nm_address).notify(
+                    "return_leased_worker", {"worker_id": lease.worker_id})
             except Exception:
                 pass
             try:

@@ -72,6 +72,7 @@ class WorkerHandle:
     direct_address: Optional[str] = None  # worker's own task server
     lease_reply: Optional[tuple] = None   # (conn, msg_id) awaiting register
     leased_conn: Optional[protocol.Conn] = None  # caller conn holding lease
+    lease_tag: Optional[bytes] = None     # GCS lease_id of the checkout
 
 
 class NodeManager:
@@ -335,6 +336,16 @@ class NodeManager:
             logger.warning("%s (pid %d)", reason, victim.proc.pid)
             with self._lock:
                 victim.death_reason = reason
+                leased_conn = victim.leased_conn \
+                    if victim.state == LEASED else None
+            if leased_conn is not None:
+                # Tell the lease holder WHY before the conn drops, so its
+                # fallback/error path can surface the OOM cause.
+                try:
+                    leased_conn.notify("leased_worker_killed", {
+                        "worker_id": victim.worker_id, "reason": reason})
+                except protocol.ConnectionClosed:
+                    pass
             self.oom_kills += 1
             try:
                 self.gcs.notify("task_events", [{
@@ -363,11 +374,16 @@ class NodeManager:
 
         task_workers = [w for w in workers
                         if w.actor_id is None and w.current_tasks]
+        # Leased workers run direct-transport tasks the NM cannot see;
+        # their holders own retry/fallback, so they count as retriable
+        # victims (the holder resubmits or surfaces a clean error).
+        leased = [w for w in workers
+                  if w.actor_id is None and w.state == LEASED]
         retriable = [w for w in task_workers
                      if any(getattr(s, "retries_left",
                                     getattr(s, "max_retries", 0))
                             for s in w.current_tasks.values())]
-        return newest(retriable) or newest(task_workers)
+        return newest(retriable + leased) or newest(task_workers)
 
     def _heartbeat_loop(self):
         """Periodic liveness report (reference: raylet heartbeats feeding
@@ -967,12 +983,41 @@ class NodeManager:
                         w.no_restart_kill = True
             elif mtype == "lease_worker":
                 self._on_lease_worker(conn, payload, msg_id)
+            elif mtype == "abandon_lease":
+                self._on_abandon_lease(conn, payload)
+            elif mtype == "kill_leased_worker":
+                # Force-cancel of a running lease task: the classic path
+                # kills the worker process (see _on_cancel_task force) —
+                # same semantics here, holder-verified.
+                with self._lock:
+                    w_k = self._workers.get(payload.get("worker_id"))
+                    if w_k is not None and w_k.leased_conn is not conn:
+                        w_k = None
+                if w_k is not None:
+                    try:
+                        w_k.proc.kill()
+                    except Exception:
+                        pass
+            elif mtype == "return_leased_worker":
+                # Explicit, authoritative return from the lease holder.
+                with self._lock:
+                    w_rel = self._workers.get(payload.get("worker_id"))
+                    if w_rel is not None and w_rel.leased_conn is not conn:
+                        w_rel = None   # not yours (stale / re-leased)
+                if w_rel is not None:
+                    self._release_leased_worker(w_rel)
             elif mtype == "lease_released":
-                # From the leased worker itself: its caller's direct conn
-                # closed (lease returned or caller died) — back to the pool.
+                # From the leased worker itself: its last direct conn
+                # closed. Honor it only when the holder is actually gone —
+                # deliberate returns arrive as return_leased_worker, and a
+                # stale notify must not free a re-leased worker under its
+                # new holder (the caller-conn check is the guard).
                 wid_rel = conn.meta.get("worker_id")
                 with self._lock:
                     w_rel = self._workers.get(wid_rel)
+                    if w_rel is not None and w_rel.leased_conn is not None \
+                            and not w_rel.leased_conn.closed:
+                        w_rel = None
                 if w_rel is not None:
                     self._release_leased_worker(w_rel)
             elif mtype == "submit_actor_task":
@@ -984,6 +1029,8 @@ class NodeManager:
                 conn.reply(msg_id, True)
             elif mtype == "fetch_object":
                 self._on_fetch_object(conn, payload, msg_id)
+            elif mtype == "fetch_object_chunk":
+                self._on_fetch_object_chunk(conn, payload, msg_id)
             elif mtype == "restore_object":
                 self._on_restore_object(conn, payload, msg_id)
             elif mtype == "spill_now":
@@ -1044,6 +1091,7 @@ class NodeManager:
         already acquired the lease's resources; here we only provide the
         process. Replies with the worker's own task-server address; if a
         fresh worker must spawn, the reply is deferred to registration."""
+        tag = p.get("lease_id")
         with self._lock:
             w = None
             while self._idle:
@@ -1056,6 +1104,8 @@ class NodeManager:
             if w is not None:
                 w.state = LEASED
                 w.leased_conn = conn
+                w.lease_tag = tag
+                w.busy_since = time.time()
         if w is not None:
             conn.reply(msg_id, {"worker_id": w.worker_id,
                                 "direct_address": w.direct_address})
@@ -1064,6 +1114,29 @@ class NodeManager:
         with self._lock:
             w.lease_reply = (conn, msg_id)
             w.leased_conn = conn
+            w.lease_tag = tag
+            w.busy_since = time.time()
+
+    def _on_abandon_lease(self, conn, p):
+        """The caller gave up on a lease (grant timeout / connect failure)
+        and already returned it to the GCS: reclaim the worker so it is
+        not stranded in LEASED with nobody ever dialing it."""
+        tag = p.get("lease_id")
+        if tag is None:
+            return
+        with self._lock:
+            w = next((x for x in self._workers.values()
+                      if x.lease_tag == tag), None)
+            if w is None:
+                return
+            if w.state == STARTING and w.lease_reply is not None:
+                # Not yet registered: registration will route it to the
+                # idle pool instead of the (gone) lease caller.
+                w.lease_reply = None
+                w.leased_conn = None
+                w.lease_tag = None
+                return
+        self._release_leased_worker(w)
 
     def _release_leased_worker(self, w: WorkerHandle):
         with self._lock:
@@ -1071,6 +1144,7 @@ class NodeManager:
                 return
             w.state = IDLE
             w.leased_conn = None
+            w.lease_tag = None
             self._idle.append(w)
         self._dispatch_queued()
 
@@ -1121,6 +1195,40 @@ class NodeManager:
             del view
             self.store.release(oid)
         conn.reply(msg_id, data)
+
+    def _on_fetch_object_chunk(self, conn, p, msg_id):
+        """Serve one chunk of a cross-node pull (reference: 5 MiB chunked
+        object-manager Push, ray_config_def.h:332 + object_manager.proto).
+        Stateless per chunk: the puller drives offsets with a bounded
+        in-flight window, so neither side ever materializes the whole
+        object on its heap. Every reply carries the total size (the first
+        chunk doubles as the metadata round trip). Falls through to
+        range-reads of spill storage for objects this node spilled."""
+        oid = p["object_id"]
+        offset, length = p["offset"], p["length"]
+        view = self.store.get_buffer(oid, timeout_ms=p.get(
+            "timeout_ms", 5000) if not self._spilled_url(oid) else 0)
+        if view is None:
+            url = self._spilled_url(oid)
+            if url is not None:
+                try:
+                    conn.reply(msg_id, {
+                        "size": self.external_storage.size(url),
+                        "data": self.external_storage.restore_range(
+                            url, offset, length),
+                    })
+                except OSError:
+                    conn.reply(msg_id, None)
+                return
+            conn.reply(msg_id, None)
+            return
+        try:
+            reply = {"size": len(view),
+                     "data": bytes(view[offset:offset + length])}
+        finally:
+            del view
+            self.store.release(oid)
+        conn.reply(msg_id, reply)
 
     # ------------------------------------------------------------- spilling
 

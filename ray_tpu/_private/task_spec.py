@@ -51,48 +51,57 @@ def normalize_resources(
 
 
 class ResourceSet:
-    """Float resource arithmetic with tolerance (reference: fixed_point.h)."""
+    """Fixed-point resource arithmetic (reference:
+    src/ray/raylet/scheduling/fixed_point.h — resources are integers
+    scaled by 1e4, so repeated fractional acquire/release cycles restore
+    EXACTLY; float drift like 0.1+0.2 can never wedge a bundle)."""
 
     __slots__ = ("_r",)
-    EPS = 1e-9
+    SCALE = 10_000          # reference: kResourceUnitScaling = 10000
+
+    @classmethod
+    def _fp(cls, v: float) -> int:
+        return round(float(v) * cls.SCALE)
 
     def __init__(self, resources: Optional[Dict[str, float]] = None):
-        self._r = dict(resources or {})
+        self._r: Dict[str, int] = {
+            k: self._fp(v) for k, v in (resources or {}).items()}
 
     def get(self, name: str) -> float:
-        return self._r.get(name, 0.0)
+        return self._r.get(name, 0) / self.SCALE
 
     def to_dict(self) -> Dict[str, float]:
-        return dict(self._r)
+        return {k: v / self.SCALE for k, v in self._r.items()}
 
     def fits(self, demand: Dict[str, float]) -> bool:
-        return all(self._r.get(k, 0.0) + self.EPS >= v for k, v in demand.items())
+        return all(self._r.get(k, 0) >= self._fp(v)
+                   for k, v in demand.items())
 
     def acquire(self, demand: Dict[str, float]) -> bool:
         if not self.fits(demand):
             return False
         for k, v in demand.items():
-            self._r[k] = self._r.get(k, 0.0) - v
+            self._r[k] = self._r.get(k, 0) - self._fp(v)
         return True
 
     def release(self, demand: Dict[str, float]) -> None:
         for k, v in demand.items():
-            self._r[k] = self._r.get(k, 0.0) + v
+            self._r[k] = self._r.get(k, 0) + self._fp(v)
 
     def add(self, other: Dict[str, float]) -> None:
         for k, v in other.items():
-            self._r[k] = self._r.get(k, 0.0) + v
+            self._r[k] = self._r.get(k, 0) + self._fp(v)
 
     def utilization(self, total: "ResourceSet") -> float:
         """Max over resources of used/total (hybrid-policy input)."""
         u = 0.0
         for k, cap in total._r.items():
             if cap > 0:
-                u = max(u, (cap - self._r.get(k, 0.0)) / cap)
+                u = max(u, (cap - self._r.get(k, 0)) / cap)
         return u
 
     def __repr__(self):
-        return f"ResourceSet({self._r})"
+        return f"ResourceSet({self.to_dict()})"
 
 
 @dataclass
@@ -104,7 +113,7 @@ class TaskSpec:
     function_key: str          # GCS function-store key
     args: bytes                # framed serialized (args, kwargs)
     arg_deps: List[ObjectID]   # objects that must be ready before dispatch
-    num_returns: int
+    num_returns: Any           # int, or "dynamic" for generator tasks
     resources: Dict[str, float]
     name: str = ""
     max_retries: int = 0
@@ -118,6 +127,11 @@ class TaskSpec:
     submitted_at: float = field(default_factory=time.time)
 
     def return_ids(self) -> List[ObjectID]:
+        if self.num_returns == "dynamic":
+            # One visible return: the ObjectRefGenerator. The yielded
+            # values get indices 1..N at execution time (reference: task
+            # manager dynamic returns, num_returns="dynamic").
+            return [ObjectID.for_return(self.task_id, 0)]
         return [ObjectID.for_return(self.task_id, i)
                 for i in range(self.num_returns)]
 

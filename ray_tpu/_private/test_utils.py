@@ -26,11 +26,17 @@ def worker_pids(nm) -> List[int]:
 
 
 def busy_worker_pids(nm) -> List[int]:
-    """PIDs of workers currently executing a task or hosting an actor."""
+    """PIDs of workers currently executing a task or hosting an actor.
+
+    Leased workers count as busy: direct-transport tasks run on them
+    without appearing in the node manager's ``current_tasks`` (the caller
+    streams specs straight to the worker), and killing one exercises the
+    lease-fallback retry path."""
     with nm._lock:
         return [w.proc.pid for w in nm._workers.values()
                 if w.proc.poll() is None
-                and (w.current_tasks or w.actor_id is not None)]
+                and (w.current_tasks or w.actor_id is not None
+                     or w.state == "leased")]
 
 
 def kill_worker(pid: int) -> None:

@@ -117,6 +117,39 @@ def _restore_ref(id_bytes: bytes, owner_hint: str) -> ObjectRef:
     return ObjectRef(ObjectID(id_bytes), owner_hint)
 
 
+class ObjectRefGenerator:
+    """The value of a ``num_returns="dynamic"`` task's single return: an
+    iterable over the ObjectRefs of the values the task yielded
+    (reference: python/ray DynamicObjectRefGenerator, exercised by
+    python/ray/tests/test_generators.py).
+
+    Carries raw id bytes — ObjectRefs materialize (and register with the
+    consumer's ref tracker) only when iterated, so the yielded objects
+    are owned by whoever actually consumes them.
+    """
+
+    __slots__ = ("_ids",)
+
+    def __init__(self, id_bytes_list: List[bytes]):
+        self._ids = list(id_bytes_list)
+
+    def __iter__(self):
+        for b in self._ids:
+            yield ObjectRef(ObjectID(b))
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def __getitem__(self, i: int) -> ObjectRef:
+        return ObjectRef(ObjectID(self._ids[i]))
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._ids,))
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({len(self._ids)} refs)"
+
+
 class _ObjArg:
     """Marker for a top-level ObjectRef argument (resolved pre-execution)."""
 
@@ -319,6 +352,10 @@ class CoreWorker:
         self._closed = False
         from ray_tpu._private.config import config as _cfg
 
+        # Pull admission control: bounds in-flight transfer chunks across
+        # all concurrent pulls (reference: pull_manager.h:52).
+        self._pull_sem = threading.Semaphore(
+            max(1, int(_cfg.pull_max_inflight_chunks)))
         if _cfg.refcount_enabled:
             self._refs = _RefTracker(self)
         # Direct task transport (reference: direct_task_transport.h:75):
@@ -339,6 +376,11 @@ class CoreWorker:
     # ----------------------------------------------------------- plumbing
 
     def _on_gcs_msg(self, conn, mtype, payload, msg_id):
+        if mtype == "revoke_lease":
+            lm = self._lease_mgr
+            if lm is not None:
+                lm.revoke(payload.get("lease_id"))
+            return
         if mtype == "pubsub":
             fn = _pubsub_dispatch
             if fn is not None:
@@ -386,12 +428,20 @@ class CoreWorker:
             return False
         return bool(freed)
 
+    def _on_nm_msg(self, conn, mtype, payload, msg_id):
+        if mtype == "leased_worker_killed":
+            lm = self._lease_mgr
+            if lm is not None:
+                lm.note_worker_killed(payload.get("worker_id"),
+                                      payload.get("reason", ""))
+
     def nm_conn(self, address: str) -> protocol.Conn:
         with self._nm_lock:
             conn = self._nm_conns.get(address)
             if conn is not None and not conn.closed:
                 return conn
-        conn = protocol.connect(address, name=f"{self.role}-nm")
+        conn = protocol.connect(address, handler=self._on_nm_msg,
+                                name=f"{self.role}-nm")
         with self._nm_lock:
             existing = self._nm_conns.get(address)
             if existing is not None and not existing.closed:
@@ -570,18 +620,110 @@ class CoreWorker:
         return rest
 
     def _fetch_from(self, address: str, oid: bytes) -> bool:
-        """Pull one object from a known holder node into the local store."""
+        """Pull one object from a known holder node into the local store.
+
+        Chunked (reference: 5 MiB object-manager chunks, pull admission
+        pull_manager.h:52): the first chunk request learns the total size,
+        the object is created straight in the local shm arena, and the
+        remaining chunks stream with a bounded in-flight window shared by
+        all concurrent pulls in this process — peak heap is
+        O(window * chunk), never O(object).
+        """
+        from ray_tpu._private.config import config as _cfg
+
+        chunk = int(_cfg.fetch_chunk_bytes)
         try:
-            data = self.nm_conn(address).request(
-                "fetch_object", {"object_id": oid}, timeout=60)
+            conn = self.nm_conn(address)
+            first = conn.request("fetch_object_chunk", {
+                "object_id": oid, "offset": 0, "length": chunk},
+                timeout=60)
         except (protocol.ConnectionClosed, protocol.RemoteCallError,
                 TimeoutError, OSError):
             return False
-        if data is None:
+        if first is None:
             return False
-        self._store_local(oid, data)
+        total = first["size"]
+        data0 = first["data"]
+        if total <= len(data0):
+            self._store_local(oid, data0)
+            self.gcs.notify("add_object_locations", {
+                "node_id": self.node_id, "objects": [(oid, total)]})
+            return True
+        try:
+            buf = self.store.create(oid, total)
+        except plasma.ObjectExistsError:
+            return True   # someone else pulled it meanwhile
+        except plasma.StoreFullError:
+            if not self._request_spill(total) and not \
+                    self.store.contains(oid):
+                return False
+            try:
+                buf = self.store.create(oid, total)
+            except (plasma.ObjectExistsError,):
+                return True
+            except plasma.StoreFullError:
+                return False
+        ok = False
+        try:
+            buf[:len(data0)] = data0
+            del data0, first
+            sem = self._pull_sem
+            failed = threading.Event()
+            cv = threading.Condition()
+            outstanding = [0]
+
+            def on_chunk(off, f):
+                try:
+                    rep = f.result(0)
+                    if rep is None:
+                        raise ValueError("chunk unavailable")
+                    buf[off:off + len(rep["data"])] = rep["data"]
+                except BaseException:
+                    failed.set()
+                finally:
+                    sem.release()
+                    with cv:
+                        outstanding[0] -= 1
+                        cv.notify()
+
+            sent_all = True
+            for off in range(chunk, total, chunk):
+                sem.acquire()
+                if failed.is_set():
+                    sem.release()
+                    sent_all = False
+                    break
+                try:
+                    fut = conn.request_nowait("fetch_object_chunk", {
+                        "object_id": oid, "offset": off,
+                        "length": min(chunk, total - off)})
+                except BaseException:
+                    sem.release()
+                    failed.set()
+                    sent_all = False
+                    break
+                with cv:
+                    outstanding[0] += 1
+                fut.add_done_callback(lambda f, o=off: on_chunk(o, f))
+            # Drain the in-flight window (futures always complete: the
+            # conn errors them out on close).
+            with cv:
+                cv.wait_for(lambda: outstanding[0] == 0, timeout=300)
+                drained = outstanding[0] == 0
+            ok = sent_all and drained and not failed.is_set()
+        finally:
+            del buf
+            if ok:
+                self.store.seal(oid)
+            else:
+                try:
+                    self.store.abort(oid)
+                except Exception:
+                    pass
+        if not ok:
+            return False
         self.gcs.notify("add_object_locations", {
-            "node_id": self.node_id, "objects": [(oid, len(data))]})
+            "node_id": self.node_id, "objects": [(oid, total)]})
         return True
 
     def _pull_objects(self, id_bytes_list: List[bytes]) -> None:
@@ -613,18 +755,7 @@ class CoreWorker:
                     if ok and self.store.contains(oid):
                         break
                     continue
-                try:
-                    data = self.nm_conn(address).request(
-                        "fetch_object", {"object_id": oid}, timeout=60)
-                except (protocol.ConnectionClosed,
-                        protocol.RemoteCallError, TimeoutError, OSError):
-                    continue
-                if data is not None:
-                    self._store_local(oid, data)
-                    self.gcs.notify("add_object_locations", {
-                        "node_id": self.node_id,
-                        "objects": [(oid, len(data))],
-                    })
+                if self._fetch_from(address, oid):
                     break
 
     def get(self, refs, timeout: Optional[float] = None):
@@ -814,7 +945,7 @@ class CoreWorker:
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True):
         if self._lease_mgr is not None and \
-                self._lease_mgr.cancel(ref.task_id().binary()):
+                self._lease_mgr.cancel(ref.task_id().binary(), force):
             return
         self.gcs.request("cancel_task", {
             "task_id": ref.task_id().binary(), "force": force})

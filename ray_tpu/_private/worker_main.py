@@ -126,7 +126,11 @@ class WorkerExecutor:
             conn.reply(msg_id, True)
 
     def _on_direct_disconnect(self, conn):
-        # The lease holder hung up: hand this worker back to the pool.
+        # The lease holder hung up. Only tell the NM when NO direct conn
+        # remains: a stale old-holder conn closing while the new holder is
+        # connected must not release the new holder's lease.
+        if any(not c.closed for c in self.direct._conns):
+            return
         try:
             self.nm.notify("lease_released", None)
         except protocol.ConnectionClosed:
@@ -182,6 +186,11 @@ class WorkerExecutor:
                 if mtype == "run_task":
                     self._execute_task(payload)
                 elif mtype == "lease_task":
+                    # Completed results must never wait behind the NEXT
+                    # task's execution (a long task would sit on a fast
+                    # predecessor's result): ship anything buffered first.
+                    if self._lease_results:
+                        self._flush_lease_results()
                     self._execute_lease_task(*payload)
                 elif mtype == "create_actor":
                     self._create_actor(payload)
@@ -205,6 +214,8 @@ class WorkerExecutor:
     # ------------------------------------------------------------ execution
 
     def _store_returns(self, spec, result) -> list:
+        if getattr(spec, "num_returns", None) == "dynamic":
+            return self._store_dynamic_returns(spec, result)
         ids = spec.return_ids()
         if not ids:
             return []
@@ -225,6 +236,41 @@ class WorkerExecutor:
             except plasma.ObjectExistsError:
                 pass
             out.append((oid.binary(), sobj.total_size()))
+        return out
+
+    def _store_dynamic_returns(self, spec, result) -> list:
+        """Generator task (num_returns="dynamic"): store each yielded
+        value at return index 1..N as it is produced, then store the
+        ObjectRefGenerator at index 0 — consumers only ever observe a
+        COMPLETE generator, so a mid-yield crash + retry is safe (partial
+        yields are re-stored idempotently; reference: task manager
+        dynamic returns, python/ray/tests/test_generators.py)."""
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.worker import ObjectRefGenerator
+
+        if not inspect.isgenerator(result) and not hasattr(
+                result, "__iter__"):
+            raise TypeError(
+                f"num_returns='dynamic' requires the task to return a "
+                f"generator/iterable, got {type(result).__name__}")
+        out = []
+        yielded_ids: list = []
+        for i, value in enumerate(result):
+            oid = ObjectID.for_return(spec.task_id, i + 1).binary()
+            sobj = serialization.serialize(value)
+            try:
+                self.core.store.put_serialized(oid, sobj)
+            except plasma.ObjectExistsError:
+                pass   # retry of a task killed mid-yield
+            yielded_ids.append(oid)
+            out.append((oid, sobj.total_size()))
+        gen_oid = spec.return_ids()[0].binary()
+        gen_obj = serialization.serialize(ObjectRefGenerator(yielded_ids))
+        try:
+            self.core.store.put_serialized(gen_oid, gen_obj)
+        except plasma.ObjectExistsError:
+            pass
+        out.append((gen_oid, gen_obj.total_size()))
         return out
 
     def _store_error_returns(self, spec, err: BaseException) -> list:

@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Any, List, Optional, Sequence, Tuple
 
 from ray_tpu._private import worker as _worker_mod
-from ray_tpu._private.worker import ObjectRef  # noqa: F401
+from ray_tpu._private.worker import ObjectRef, ObjectRefGenerator  # noqa: F401
 
 
 def init(

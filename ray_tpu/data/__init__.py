@@ -10,6 +10,7 @@ training step (mesh shardings), not the dataset.
 """
 
 from ray_tpu.data.dataset import (  # noqa: F401
+    DataContext,
     Dataset,
     DatasetPipeline,
     from_items,

@@ -117,6 +117,44 @@ def _apply_stages(rows: List[Any], stages: List[_Stage]) -> List[Any]:
 # --------------------------------------------------------------- dataset
 
 
+class DataContext:
+    """Execution knobs (reference: ``python/ray/data/context.py``
+    DataContext.target_max_block_size — here row-count based).
+
+    ``target_max_rows_per_block``: when set, block tasks run as dynamic
+    generator tasks (``num_returns="dynamic"``) and split oversized
+    outputs into multiple blocks of at most this many rows — the block
+    count becomes data-dependent, which is exactly what dynamic returns
+    exist for (reference: task manager dynamic returns feeding Data
+    block splitting).
+    """
+
+    _instance: Optional["DataContext"] = None
+
+    def __init__(self):
+        self.target_max_rows_per_block: Optional[int] = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+
+def _split_rows(rows: List[Any], max_rows: int):
+    for i in builtins.range(0, len(rows), max_rows):
+        yield rows[i:i + max_rows]
+
+
+def _resolve_dynamic_blocks(gen_refs: List[Any]) -> List[Any]:
+    """Flatten generator refs into per-block refs (one small get per
+    generator object; the blocks themselves stay in the store)."""
+    out: List[Any] = []
+    for gen in ray_tpu.get(gen_refs):
+        out.extend(gen)
+    return out
+
+
 class Dataset:
     def __init__(self, block_refs: List[Any],
                  stages: Optional[List[_Stage]] = None):
@@ -139,6 +177,22 @@ class Dataset:
             self._cached = self._input_blocks
             return self._cached
         stages = self._stages
+        max_rows = DataContext.get_current().target_max_rows_per_block
+
+        if max_rows:
+            # Dynamic-generator execution: a block task yields as many
+            # output blocks as its data needs (block-size targeting).
+            @ray_tpu.remote(num_returns="dynamic")
+            def _run_block_dyn(rows):
+                out = _apply_stages(rows, stages)
+                if not out:
+                    yield out
+                else:
+                    yield from _split_rows(out, max_rows)
+
+            self._cached = _resolve_dynamic_blocks(
+                [_run_block_dyn.remote(b) for b in self._input_blocks])
+            return self._cached
 
         @ray_tpu.remote
         def _run_block(rows):
@@ -612,6 +666,21 @@ def _expand_paths(paths) -> List[str]:
 
 def _read_files(paths, reader: Callable, parallelism: int) -> Dataset:
     files = _expand_paths(paths)
+    max_rows = DataContext.get_current().target_max_rows_per_block
+
+    if max_rows:
+        # A read task emits one block per target-size chunk of its file —
+        # variable counts per file, via dynamic returns.
+        @ray_tpu.remote(num_returns="dynamic")
+        def load_dyn(fp):
+            rows = reader(fp)
+            if not rows:
+                yield rows
+            else:
+                yield from _split_rows(rows, max_rows)
+
+        return Dataset(_resolve_dynamic_blocks(
+            [load_dyn.remote(fp) for fp in files]))
 
     @ray_tpu.remote
     def load(fp):

@@ -14,7 +14,19 @@ import mmap
 import os
 import subprocess
 import threading
+import weakref
 from typing import Optional
+
+
+def _release_pin(client: "PlasmaClient", object_id: bytes) -> None:
+    """weakref.finalize target for zero-copy values: unpin the object once
+    the last arena view is collected. Tolerates an already-closed client
+    (finalizers can outlive the store at interpreter shutdown)."""
+    try:
+        if not client._closed:
+            client._lib.rtpu_release(client._handle, object_id)
+    except Exception:
+        pass
 
 from ray_tpu._private import serialization
 from ray_tpu.exceptions import OutOfMemoryError
@@ -257,22 +269,52 @@ class PlasmaClient:
         self.seal(object_id)
         return size
 
+    # Objects at or above this deserialize zero-copy out of the arena,
+    # pinned until the returned value is garbage collected (reference:
+    # plasma zero-copy numpy reads — arrays are READ-ONLY views). Below
+    # it, copying costs less than pin bookkeeping.
+    ZERO_COPY_MIN = 1 * 1024 * 1024
+
     def get_value(self, object_id: bytes, timeout_ms: int = -1):
         """Deserialize a stored value.
 
-        Buffers are copied out of the arena before unpickling so the slot can
-        be evicted safely after release. (A pinned zero-copy path exists via
-        ``get_buffer`` for callers that manage the pin lifetime themselves.)
+        Small objects are copied out of the arena before unpickling so
+        the slot can be evicted safely after release. Large objects
+        deserialize zero-copy: their buffers (e.g. numpy arrays) view
+        the shm arena directly, read-only, and the object stays pinned
+        in the store until the last such view is garbage collected —
+        O(1) heap for any object size (the property the chunked-transfer
+        memory test asserts end to end).
         """
-        view = self.get_buffer(object_id, timeout_ms)
-        if view is None:
+        self._check_open()
+        off = ctypes.c_uint64()
+        size_c = ctypes.c_uint64()
+        rc = self._lib.rtpu_get(self._handle, object_id, timeout_ms,
+                                ctypes.byref(off), ctypes.byref(size_c))
+        if rc in (RTPU_TIMEOUT, RTPU_NOT_FOUND):
             return None, False
+        if rc != RTPU_OK:
+            raise OSError(f"get failed rc={rc}")
+        size = size_c.value
+        if size < self.ZERO_COPY_MIN:
+            view = self._view[off.value:off.value + size]
+            try:
+                data = bytes(view)  # copy out; eviction decoupled from GC
+            finally:
+                del view
+                self.release(object_id)
+            return serialization.loads_oob(data), True
+        # Zero-copy path: a ctypes exporter over the arena slab. Views
+        # sliced from it (pickle5 out-of-band buffers) keep the exporter
+        # alive, and the exporter's collection releases the store pin.
+        exporter = (ctypes.c_char * size).from_buffer(self._map, off.value)
+        weakref.finalize(exporter, _release_pin, self, bytes(object_id))
+        view = memoryview(exporter).cast("B").toreadonly()
         try:
-            data = bytes(view)  # copy out; keeps eviction decoupled from GC
+            value = serialization.loads_oob(view)
         finally:
             del view
-            self.release(object_id)
-        return serialization.loads_oob(data), True
+        return value, True
 
     def close(self) -> None:
         if self._closed:
@@ -280,7 +322,13 @@ class PlasmaClient:
         self._closed = True
         try:
             self._view.release()
-            self._map.close()
+            try:
+                self._map.close()
+            except BufferError:
+                # Live zero-copy values still export arena buffers; the
+                # mapping stays until they are collected (process exit
+                # cleans up regardless).
+                pass
             os.close(self._fd)
         finally:
             self._lib.rtpu_store_detach(self._handle)

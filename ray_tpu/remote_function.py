@@ -97,7 +97,9 @@ class RemoteFunction:
         )
         if o["num_returns"] == 0:
             return None
-        if o["num_returns"] == 1:
+        if o["num_returns"] == 1 or o["num_returns"] == "dynamic":
+            # dynamic: one ref whose value is an ObjectRefGenerator
+            # (reference: python/ray generator tasks, test_generators.py).
             return refs[0]
         return refs
 

@@ -88,8 +88,11 @@ def start(http_port: Optional[int] = _DEFAULT_HTTP_PORT,
     except Exception:
         pass
     ctrl_cls = ray_tpu.remote(ServeController)
+    # Threaded actor: parked listen_for_change long-polls (one per live
+    # handle/proxy) must not serialize control calls.
     ctrl = ctrl_cls.options(
         name=CONTROLLER_NAME,
+        max_concurrency=64,
         lifetime="detached" if detached else None).remote(
         http_port=http_port)
     import time
@@ -109,15 +112,38 @@ def _controller():
     return ray_tpu.get_actor(CONTROLLER_NAME)
 
 
+def _deploy_children(args, kwargs, http_port):
+    """Deployment-graph build (reference:
+    ``serve/_private/deployment_graph_build.py`` — a bound node's args may
+    contain OTHER bound nodes; children deploy first, and the parent's
+    constructor receives their DeploymentHandles). Collapsed here to the
+    essential recursion: Application-in-args -> deploy -> handle."""
+    def resolve(v):
+        if isinstance(v, Application):
+            return run(v, http_port=http_port)
+        if isinstance(v, (list, tuple)):
+            return type(v)(resolve(x) for x in v)
+        if isinstance(v, dict):
+            return {k: resolve(x) for k, x in v.items()}
+        return v
+
+    return (tuple(resolve(a) for a in args),
+            {k: resolve(v) for k, v in kwargs.items()})
+
+
 def run(app: Application, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None,
         http_port: Optional[int] = _DEFAULT_HTTP_PORT,
         _blocking: bool = False) -> DeploymentHandle:
-    """Deploy an application; returns a handle (reference: serve.run
-    ``serve/api.py:458``)."""
+    """Deploy an application — including multi-deployment graphs built by
+    nesting ``.bind()`` results — and return the root handle (reference:
+    serve.run ``serve/api.py:458`` + deployment_graph_build.py)."""
     import ray_tpu
 
     start(http_port=http_port)
+    init_args, init_kwargs = _deploy_children(app.init_args,
+                                              app.init_kwargs, http_port)
+    app = Application(app.deployment, init_args, init_kwargs)
     dep = app.deployment
     cfg = dep._config
     if route_prefix is not None:

@@ -4,9 +4,14 @@ detached actor running a reconciliation loop;
 
 Holds target state per deployment (replica count, config), reconciles
 actual replica actors toward it in a background thread, autoscales from
-replica queue stats, and serves the replica directory to handles/proxies
-(the reference pushes via LongPollHost ``_private/long_poll.py:185``;
-handles here poll with a short TTL cache).
+replica queue stats, and PUSHES the replica directory to handles/proxies
+through a versioned long-poll channel (reference: LongPollHost
+``_private/long_poll.py:185,68`` — ``listen_for_change`` parks until a
+watched key advances past the caller's snapshot). Replica death is
+detected from the GCS actor-state pubsub channel (reference:
+``_private/deployment_state.py:998`` liveness from actor events), not
+probe-miss counting — stat probes only feed autoscaling, with a long
+miss threshold kept as a backstop for wedged-but-alive replicas.
 """
 
 from __future__ import annotations
@@ -48,11 +53,95 @@ class ServeController:
         self._running = True
         self._http_port = http_port
         self._proxy = None
+        # Long-poll state: key -> monotonically increasing version.
+        self._versions: Dict[str, int] = {}
+        self._change_cv = threading.Condition()
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True, name="serve-reconcile")
         self._thread.start()
+        self._death_sub = None
+        threading.Thread(target=self._actor_death_loop, daemon=True,
+                         name="serve-death-watch").start()
         if http_port is not None:
             self._start_proxy(http_port)
+
+    # ------------------------------------------------------- long poll
+
+    def _bump(self, key: str):
+        with self._change_cv:
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._change_cv.notify_all()
+
+    def _snapshot(self, key: str):
+        if key.startswith("replicas:"):
+            name = key.split(":", 1)[1]
+            with self._lock:
+                st = self._deployments.get(name)
+                return list(st.replicas) if st else []
+        return None
+
+    def listen_for_change(self, snapshot_ids: Dict[str, int],
+                          timeout_s: float = 30.0) -> Dict[str, tuple]:
+        """Park until any watched key's version exceeds the caller's
+        snapshot; returns {key: (version, value)} ({} on timeout). The
+        push half of the reference's LongPollHost (long_poll.py:185) —
+        handles/proxies learn of replica-set changes within one notify,
+        not one TTL."""
+        deadline = time.time() + timeout_s
+        with self._change_cv:
+            while self._running:
+                updates = {}
+                for key, ver in snapshot_ids.items():
+                    cur = self._versions.get(key, 0)
+                    if cur > ver:
+                        updates[key] = (cur, self._snapshot(key))
+                if updates:
+                    return updates
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return {}
+                self._change_cv.wait(min(remaining, 1.0))
+        return {}
+
+    # ------------------------------------------------- death subscription
+
+    def _actor_death_loop(self):
+        """Replica liveness from GCS actor events (pubsub), replacing the
+        probe-miss heuristic: a death notification prunes + replaces the
+        replica on the next reconcile tick, regardless of probe state."""
+        import queue as queue_mod
+
+        from ray_tpu.experimental import pubsub
+
+        try:
+            self._death_sub = pubsub.subscribe("actor_state")
+        except Exception:
+            return
+        while self._running:
+            try:
+                msg = self._death_sub.get(timeout=1.0)
+            except queue_mod.Empty:
+                continue
+            except Exception:
+                time.sleep(0.5)
+                continue
+            if not isinstance(msg, dict) or msg.get("state") != "DEAD":
+                continue
+            aid = msg.get("actor_id")
+            changed = []
+            with self._lock:
+                for name, st in self._deployments.items():
+                    for r in list(st.replicas):
+                        rid = getattr(r, "_actor_id", None)
+                        if rid is not None and rid.hex() == aid:
+                            st.replicas.remove(r)
+                            changed.append((name, st))
+            for name, st in changed:
+                self._bump(f"replicas:{name}")
+                try:
+                    self._scale_to_target(name, st)
+                except Exception:
+                    pass
 
     # ----------------------------------------------------------- deploy API
 
@@ -70,6 +159,7 @@ class ServeController:
         with self._lock:
             st = self._deployments[name]
         self._scale_to_target(name, st)
+        self._bump(f"replicas:{name}")
         return True
 
     def delete_deployment(self, name: str) -> bool:
@@ -77,6 +167,7 @@ class ServeController:
             st = self._deployments.pop(name, None)
         if st is not None:
             self._kill_replicas(st.replicas)
+        self._bump(f"replicas:{name}")
         return True
 
     def get_replicas(self, name: str) -> List[Any]:
@@ -100,6 +191,8 @@ class ServeController:
 
     def shutdown(self) -> bool:
         self._running = False
+        with self._change_cv:
+            self._change_cv.notify_all()
         with self._lock:
             for st in self._deployments.values():
                 self._kill_replicas(st.replicas)
@@ -173,6 +266,8 @@ class ServeController:
                 with self._lock:
                     if r in st.replicas:
                         st.replicas.remove(r)
+                        self._bump(
+                            f"replicas:{st.config['name']}")
                 self._kill_replicas([r])
 
         now = time.time()
@@ -243,6 +338,8 @@ class ServeController:
                 extra, st.replicas = (st.replicas[st.target:],
                                       st.replicas[:st.target])
             self._kill_replicas(extra)
+        if deficit:
+            self._bump(f"replicas:{name}")
 
     @staticmethod
     def _kill_replicas(replicas):

@@ -4,6 +4,12 @@
 ``handle.remote(...)`` picks the least-loaded replica (power of two
 choices over cached stats, reference: router's replica set scheduling)
 and returns a ``DeploymentResponse`` whose ``.result()`` blocks.
+
+Replica-set updates are PUSHED: a background listener long-polls the
+controller's versioned channel (reference: LongPollClient,
+_private/long_poll.py:68) so membership changes land within one notify;
+the TTL refresh remains only as bootstrap + fallback while the listener
+is (re)connecting.
 """
 
 from __future__ import annotations
@@ -55,6 +61,42 @@ class DeploymentHandle:
         self._fetched_at = 0.0
         self._lock = threading.Lock()
         self._rr = random.Random()
+        self._listener_started = False
+
+    def __reduce__(self):
+        # Handles travel into replicas (deployment graphs); the listener
+        # thread restarts lazily on the other side.
+        return (DeploymentHandle, (self.deployment_name, self._method))
+
+    def _ensure_listener(self):
+        with self._lock:
+            if self._listener_started:
+                return
+            self._listener_started = True
+        threading.Thread(target=self._listen_loop, daemon=True,
+                         name=f"serve-longpoll-{self.deployment_name}"
+                         ).start()
+
+    def _listen_loop(self):
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        key = f"replicas:{self.deployment_name}"
+        version = 0
+        while True:
+            try:
+                ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+                updates = ray_tpu.get(
+                    ctrl.listen_for_change.remote({key: version}, 25.0),
+                    timeout=35)
+            except Exception:
+                time.sleep(1.0)
+                continue
+            if key in updates:
+                version, replicas = updates[key]
+                with self._lock:
+                    self._replicas = list(replicas)
+                    self._fetched_at = time.time()
 
     def options(self, method_name: str) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, method_name)
@@ -73,8 +115,10 @@ class DeploymentHandle:
 
         now = time.time()
         with self._lock:
+            # With a live push listener the poll is only a safety net.
+            ttl = 10.0 if self._listener_started else _REPLICA_CACHE_TTL_S
             if not force and self._replicas and \
-                    now - self._fetched_at < _REPLICA_CACHE_TTL_S:
+                    now - self._fetched_at < ttl:
                 return
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
         replicas = ray_tpu.get(
@@ -86,6 +130,7 @@ class DeploymentHandle:
     def _pick(self):
         import ray_tpu
 
+        self._ensure_listener()
         self._refresh()
         with self._lock:
             replicas = list(self._replicas)

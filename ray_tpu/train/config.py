@@ -26,6 +26,9 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
     topology: Optional[str] = None
+    # Runtime env for every gang worker (e.g. env_vars selecting the JAX
+    # platform / per-host device count on CPU test meshes).
+    worker_runtime_env: Optional[Dict[str, Any]] = None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -64,13 +64,17 @@ class DataParallelTrainer:
 
         while attempts_left > 0:
             attempts_left -= 1
+            existing_pg = getattr(self, "_existing_pg", None)
             group = WorkerGroup(
                 self.scaling_config.num_workers,
                 self.scaling_config.worker_resources(),
                 placement_strategy=self.scaling_config.placement_strategy,
                 backend=self._backend,
                 group_name=f"train_{name}_{uuid.uuid4().hex[:6]}",
-                experiment_name=name)
+                experiment_name=name,
+                runtime_env=self.scaling_config.worker_runtime_env,
+                existing_pg=existing_pg,
+                bundle_offset=1 if existing_pg is not None else 0)
             try:
                 group.start(self._train_loop, self._config, latest_ckpt,
                             datasets=self._datasets)

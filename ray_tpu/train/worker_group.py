@@ -122,12 +122,24 @@ class WorkerGroup:
                  *, placement_strategy: str = "PACK",
                  backend: str = "store",
                  group_name: str = "train_default",
-                 experiment_name: str = ""):
+                 experiment_name: str = "",
+                 runtime_env: Optional[Dict[str, Any]] = None,
+                 existing_pg=None, bundle_offset: int = 0):
         self.num_workers = num_workers
         self.group_name = group_name
-        bundles = [dict(resources_per_worker) for _ in range(num_workers)]
-        self.pg = placement_group(bundles, strategy=placement_strategy)
-        self.pg.wait(timeout_seconds=60)
+        # A Tune trial hands the gang its pre-reserved placement group
+        # (PlacementGroupFactory convention: bundle 0 = trial driver,
+        # 1..N = these workers); otherwise the gang reserves its own.
+        self._owns_pg = existing_pg is None
+        self._bundle_offset = bundle_offset
+        if existing_pg is not None:
+            self.pg = existing_pg
+        else:
+            bundles = [dict(resources_per_worker)
+                       for _ in range(num_workers)]
+            self.pg = placement_group(bundles,
+                                      strategy=placement_strategy)
+            self.pg.wait(timeout_seconds=60)
 
         cls = ray_tpu.remote(TrainWorker)
         num_cpus = resources_per_worker.get("CPU", 1)
@@ -135,7 +147,9 @@ class WorkerGroup:
         self.workers = [
             cls.options(num_cpus=num_cpus, num_tpus=num_tpus,
                         placement_group=self.pg,
-                        placement_group_bundle_index=i).remote(
+                        placement_group_bundle_index=i
+                        + self._bundle_offset,
+                        runtime_env=runtime_env).remote(
                 world_rank=i, world_size=num_workers, local_rank=i,
                 group_name=group_name, backend=backend,
                 experiment_name=experiment_name)
@@ -178,7 +192,8 @@ class WorkerGroup:
                 ray_tpu.kill(w)
             except Exception:
                 pass
-        try:
-            remove_placement_group(self.pg)
-        except Exception:
-            pass
+        if self._owns_pg:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass

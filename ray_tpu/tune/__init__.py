@@ -17,13 +17,14 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     PopulationBasedTraining,
 )
 from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid  # noqa: F401
+from ray_tpu.tune.placement_groups import PlacementGroupFactory  # noqa: F401
 from ray_tpu.train.session import report  # noqa: F401  (tune.report alias)
 
 __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "sample_from", "BasicVariantGenerator", "FIFOScheduler",
     "AsyncHyperBandScheduler", "ASHAScheduler", "PopulationBasedTraining",
-    "TuneConfig", "Tuner",
+    "TuneConfig", "Tuner", "PlacementGroupFactory",
     "ResultGrid", "report",
 ]
 

@@ -47,6 +47,7 @@ class Trial:
         self.config = config
         self.state = "PENDING"   # RUNNING / TERMINATED / ERROR / STOPPED
         self.actor = None
+        self.pg = None           # reserved group (PlacementGroupFactory)
         self.reports: List[Dict[str, Any]] = []
         self.checkpoint: Optional[Checkpoint] = None
         self.error: Optional[str] = None
@@ -141,21 +142,48 @@ class Tuner:
         if hasattr(scheduler, "on_trial_add"):
             for t in trials:
                 scheduler.on_trial_add(t.trial_id, t.config)
+        from ray_tpu.tune.placement_groups import PlacementGroupFactory
+        from ray_tpu.util.placement_group import placement_group
+
         res = self.tune_config.resources_per_trial or {"CPU": 1.0}
+        pg_factory = res if isinstance(res, PlacementGroupFactory) else None
         max_conc = self.tune_config.max_concurrent_trials or \
             max(1, len(trials))
         max_failures = self.run_config.failure_config.max_failures
         worker_cls = ray_tpu.remote(TrainWorker)
 
         def launch(trial: Trial):
-            trial.actor = worker_cls.options(
-                num_cpus=res.get("CPU", 1),
-                num_tpus=res.get("TPU", 0)).remote(
+            opts: Dict[str, Any] = {}
+            config = dict(trial.config)
+            if pg_factory is not None:
+                # Atomic gang reservation (reference:
+                # tune/execution/placement_groups.py): the whole trial —
+                # driver + inner trainer workers — places as ONE group,
+                # so concurrent multi-worker trials can never deadlock on
+                # partial placement. Bundle 0 hosts the trial driver; the
+                # inner trainer gang takes bundles 1..N.
+                trial.pg = placement_group(pg_factory.bundles,
+                                           strategy=pg_factory.strategy)
+                if not trial.pg.wait(timeout_seconds=120):
+                    raise RuntimeError(
+                        f"trial {trial.trial_id}: placement group not "
+                        f"ready (cluster too small for "
+                        f"{pg_factory.bundles}?)")
+                head = pg_factory.head_bundle
+                opts = dict(num_cpus=head.get("CPU", 1),
+                            num_tpus=head.get("TPU", 0),
+                            placement_group=trial.pg,
+                            placement_group_bundle_index=0)
+                config["__trial_pg__"] = trial.pg
+            else:
+                opts = dict(num_cpus=res.get("CPU", 1),
+                            num_tpus=res.get("TPU", 0))
+            trial.actor = worker_cls.options(**opts).remote(
                 world_rank=0, world_size=1, local_rank=0,
                 group_name="", backend="store", experiment_name=name)
             ckpt_path = trial.checkpoint.path if trial.checkpoint else None
             ray_tpu.get(trial.actor.start.remote(
-                fn_blob, trial.config, ckpt_path))
+                fn_blob, config, ckpt_path))
             trial.state = "RUNNING"
 
         while True:
@@ -235,6 +263,16 @@ class Tuner:
         except Exception:
             pass
         trial.actor = None
+        if trial.pg is not None:
+            from ray_tpu.util.placement_group import (
+                remove_placement_group,
+            )
+
+            try:
+                remove_placement_group(trial.pg)
+            except Exception:
+                pass
+            trial.pg = None
 
 
 def _trainer_trial_fn(trainer):
@@ -247,7 +285,13 @@ def _trainer_trial_fn(trainer):
     def run(config):
         from ray_tpu.train import session as sess_mod
 
+        config = dict(config)
+        trial_pg = config.pop("__trial_pg__", None)
         t = copy.copy(trainer)
+        if trial_pg is not None:
+            # Reuse the trial's reserved group for the inner gang
+            # (bundles 1..N; see tune/placement_groups.py).
+            t._existing_pg = trial_pg
         merged = dict(t._config or {})
         merged.update(config.get("train_loop_config", config))
         t._config = merged

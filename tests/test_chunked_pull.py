@@ -1,0 +1,126 @@
+"""Chunked cross-node object transfer with pull admission control.
+
+Reference behaviors under test: 5 MiB transfer chunks
+(src/ray/common/ray_config_def.h:332, object_manager.proto), bounded
+in-flight pull quota (src/ray/object_manager/pull_manager.h:52), and
+chunked restore of spilled objects. The memory assertion pins the point
+of chunking: pulling an object must not buffer a second whole copy on
+either side's heap.
+"""
+
+import resource
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu._private.config import config
+
+
+@pytest.fixture
+def two_node_small_chunks():
+    # 256 KiB chunks so a few-MiB object exercises many chunks fast.
+    config.set("fetch_chunk_bytes", 256 * 1024)
+    config.set("pull_max_inflight_chunks", 4)
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 2, "object_store_memory": 256 * 1024 * 1024})
+    cluster.add_node(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+    cluster.connect(object_store_memory=256 * 1024 * 1024)
+    cluster.wait_for_nodes()
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+    config.set("fetch_chunk_bytes", 5 * 1024 * 1024)
+    config.set("pull_max_inflight_chunks", 8)
+
+
+def test_chunked_pull_roundtrip(two_node_small_chunks):
+    """A multi-chunk object produced on the remote node arrives intact."""
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def make(seed):
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, 255, 3 * 1024 * 1024, dtype=np.uint8)
+
+    refs = [make.remote(s) for s in range(4)]
+    vals = ray_tpu.get(refs, timeout=120)
+    for s, v in zip(range(4), vals):
+        expect = np.random.default_rng(s).integers(
+            0, 255, 3 * 1024 * 1024, dtype=np.uint8)
+        np.testing.assert_array_equal(v, expect)
+
+
+def test_chunked_pull_bounded_memory(two_node_small_chunks):
+    """Pulling a large object must not buffer a whole second copy on
+    anyone's Python heap: peak heap growth during the pull stays at
+    O(window * chunk), not O(object).
+
+    tracemalloc is the right probe here because the test-process RSS
+    includes BOTH in-process node managers' shm arenas (cluster_utils
+    runs them in one process); the heap is where an unchunked transfer
+    would buffer the 96 MiB blob twice (sender bytes() + receiver
+    data), and that is exactly what chunking eliminates.
+    """
+    import tracemalloc
+
+    size = 96 * 1024 * 1024
+
+    @ray_tpu.remote(num_cpus=1)
+    def make_big():
+        return np.zeros(96 * 1024 * 1024, dtype=np.uint8)
+
+    ref = make_big.remote()
+    ray_tpu.wait([ref], timeout=120)
+    tracemalloc.start()
+    try:
+        val = ray_tpu.get(ref, timeout=180)
+        _cur, peak = tracemalloc.get_traced_memory()
+    finally:
+        tracemalloc.stop()
+    assert val.nbytes == size
+    # window(4) * chunk(256 KiB) = 1 MiB of transfer buffers; allow 16x
+    # slack for unrelated allocations. An unchunked transfer would peak
+    # at >= size (one whole-blob bytes copy on the serving side alone).
+    assert peak < 16 * 1024 * 1024, f"heap peaked at {peak/1e6:.0f} MB"
+    del val
+
+
+def test_concurrent_pulls_do_not_blow_store(two_node_small_chunks):
+    """8 concurrent multi-chunk pulls complete with a bounded shared
+    admission window (no OOM, no deadlock)."""
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def chunk_blob(seed):
+        return np.full(4 * 1024 * 1024, seed, dtype=np.uint8)
+
+    refs = [chunk_blob.remote(s) for s in range(8)]
+    vals = ray_tpu.get(refs, timeout=180)
+    for s, v in zip(range(8), vals):
+        assert v[0] == s and v[-1] == s and v.nbytes == 4 * 1024 * 1024
+
+
+def test_chunked_restore_from_spill(two_node_small_chunks):
+    """A spilled object on the holder node is served to a remote puller
+    by range-reading spill storage (no whole-blob materialization)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    # Put a multi-chunk object locally, spill it via its node manager,
+    # then fetch it back through the chunk path pretending to be remote.
+    blob = np.arange(2 * 1024 * 1024, dtype=np.uint8)
+    ref = ray_tpu.put(blob)
+    oid = ref.binary()
+    cluster = two_node_small_chunks
+    head = cluster.nodes[0]
+    # Spill everything spillable on the head node.
+    head._spill_bytes(1 << 30)
+    if not head._spilled_url(oid):
+        pytest.skip("object was not spilled (store pressure too low)")
+    # Evict the in-memory copy so the fetch must hit spill storage.
+    w.store.delete(oid)
+    assert not w.store.contains(oid)
+    addr = head.address
+    assert w._fetch_from(addr, oid)
+    got, ok = w.store.get_value(oid, timeout_ms=10_000)
+    assert ok
+    np.testing.assert_array_equal(got, blob)

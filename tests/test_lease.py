@@ -178,3 +178,67 @@ def test_lease_disabled_still_works(monkeypatch):
     finally:
         ray_tpu.shutdown()
         config.set("lease_enabled", True)
+
+
+def test_lease_force_cancel_kills_worker(lease_cluster):
+    """force=True on a lease task kills the worker process (classic
+    force-cancel semantics) and the ref resolves to TaskCancelledError,
+    never a silent hang or a resubmission."""
+    @ray_tpu.remote
+    def stuck():
+        import time as _t
+        _t.sleep(600)
+
+    ref = stuck.remote()
+    deadline = time.time() + 30
+    lm = _lease_mgr()
+    while time.time() < deadline:   # wait until it's running on a lease
+        if ref.task_id().binary() in lm._task_lease:
+            break
+        time.sleep(0.1)
+    time.sleep(0.5)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises((ray_tpu.exceptions.TaskCancelledError,
+                        ray_tpu.exceptions.WorkerCrashedError)):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_lease_fairness_actor_not_starved(lease_cluster):
+    """Sustained lease traffic saturating every CPU must not starve the
+    classic path: an actor created mid-stream still comes up (GCS denies
+    new leases and revokes held ones under classic-queue pressure)."""
+    @ray_tpu.remote
+    def busy(x):
+        import time as _t
+        _t.sleep(0.05)
+        return x
+
+    stream = [busy.remote(i) for i in range(120)]   # > 4 CPUs of work
+
+    @ray_tpu.remote
+    class Probe:
+        def ping(self):
+            return "up"
+
+    probe = Probe.remote()
+    assert ray_tpu.get(probe.ping.remote(), timeout=60) == "up"
+    assert ray_tpu.get(stream, timeout=120) == list(range(120))
+
+
+def test_lease_fast_result_not_stuck_behind_slow(lease_cluster):
+    """A fast task's result must reach the caller promptly even when a
+    long task runs right behind it on the same leased worker (results
+    may never buffer across the next task's execution)."""
+    @ray_tpu.remote
+    def job(t):
+        import time as _t
+        _t.sleep(t)
+        return t
+
+    fast = job.remote(0.05)
+    slow = job.remote(20)
+    t0 = time.time()
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=10)
+    assert ready == [fast] and not_ready == [slow]
+    assert time.time() - t0 < 5
+    ray_tpu.cancel(slow, force=True)

@@ -220,3 +220,72 @@ def test_autoscaler_smoothing_ignores_single_spike():
         ctl._autoscale_one(st, {id(st.replicas[0]): {"ongoing": 8}},
                            now + 6 + i)
     assert st.target >= 4, st.target
+
+
+def test_deployment_graph_composition(serve_cluster):
+    """Multi-deployment app via nested .bind(): children deploy first and
+    the parent receives live DeploymentHandles (reference:
+    serve/deployment_graph_build.py)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, inc):
+            self.inc = inc
+
+        def __call__(self, x):
+            return x + self.inc
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, doubler, adder):
+            self.doubler = doubler
+            self.adder = adder
+
+        def __call__(self, x):
+            y = self.doubler.remote(x).result(timeout=30)
+            return self.adder.remote(y).result(timeout=30)
+
+    app = Pipeline.bind(Doubler.bind(), Adder.bind(10))
+    handle = serve.run(app, http_port=None)
+    assert handle.remote(5).result(timeout=60) == 20   # 5*2 + 10
+    assert serve.status().keys() >= {"Pipeline", "Doubler", "Adder"}
+
+
+def test_replica_death_detected_via_actor_events(serve_cluster):
+    """Killing a replica actor: the controller learns via the GCS
+    actor-state channel and replaces it promptly (not after 30 probe
+    misses), and handles see the new replica set via long-poll push."""
+    import time as _t
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind(), http_port=None)
+    assert handle.remote(1).result(timeout=30) == 1
+    from ray_tpu.serve.api import _controller
+    ctrl = _controller()
+    replicas = ray_tpu.get(ctrl.get_replicas.remote("Echo"))
+    assert len(replicas) == 2
+    ray_tpu.kill(replicas[0])
+    # Replacement should land well inside the probe-miss budget (~6s+).
+    deadline = _t.time() + 15
+    while _t.time() < deadline:
+        current = ray_tpu.get(ctrl.get_replicas.remote("Echo"))
+        live = [r for r in current if r is not replicas[0]]
+        if len(current) == 2 and replicas[0] not in current:
+            break
+        _t.sleep(0.3)
+    current = ray_tpu.get(ctrl.get_replicas.remote("Echo"))
+    assert len(current) == 2 and replicas[0] not in current
+    assert handle.remote(7).result(timeout=30) == 7
