@@ -59,6 +59,7 @@ class NodeEntry:
     labels: Dict[str, str] = field(default_factory=dict)
     is_head: bool = False
     alive: bool = True
+    hw: Dict[str, Any] = field(default_factory=dict)  # reporter sample
     started_at: float = field(default_factory=time.time)
     last_heartbeat: float = field(default_factory=time.time)
 
@@ -416,6 +417,8 @@ class GcsServer:
                 node.last_heartbeat = time.time()
                 if "oom_kills" in p:
                     node.labels["oom_kills"] = str(p["oom_kills"])
+                if "hw" in p:
+                    node.hw = p["hw"]
 
     def _expire_recovering_actors(self, now: float):
         due = [aid for aid, t in self._recovering_actors.items() if now >= t]
@@ -599,6 +602,7 @@ class GcsServer:
                     "Available": n.available.to_dict(),
                     "Labels": dict(n.labels),
                     "IsHead": n.is_head,
+                    "Hardware": dict(n.hw),
                 })
             conn.reply(msg_id, out)
 

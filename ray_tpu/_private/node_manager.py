@@ -388,16 +388,80 @@ class NodeManager:
     def _heartbeat_loop(self):
         """Periodic liveness report (reference: raylet heartbeats feeding
         gcs_health_check_manager.h:39). A wedged-but-connected node stops
-        heartbeating and the GCS declares it dead."""
+        heartbeating and the GCS declares it dead.
+
+        Each heartbeat carries a hardware sample — the per-node reporter
+        agent (reference: dashboard/modules/reporter/reporter_agent.py:253
+        collecting CPU/mem/GPU per node; here CPU/mem/object-store/TPU-chip
+        stats, surfaced via the nodes API and /metrics gauges)."""
         period = max(0.05, config.raylet_heartbeat_period_ms / 1000.0)
+        prev_cpu = self._read_proc_stat()
         while not self._shutdown:
             time.sleep(period)
             try:
+                cur_cpu = self._read_proc_stat()
+                hw = self._sample_hardware(prev_cpu, cur_cpu)
+                prev_cpu = cur_cpu
                 self.gcs.notify("heartbeat", {
                     "node_id": self.node_id,
-                    "oom_kills": getattr(self, "oom_kills", 0)})
+                    "oom_kills": getattr(self, "oom_kills", 0),
+                    "hw": hw})
             except Exception:
                 pass  # disconnected; the rejoin path owns recovery
+
+    @staticmethod
+    def _read_proc_stat():
+        """(busy_jiffies, total_jiffies) from /proc/stat, or None."""
+        try:
+            with open("/proc/stat") as f:
+                parts = f.readline().split()[1:]
+            vals = [int(x) for x in parts[:8]]
+            total = sum(vals)
+            idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+            return (total - idle, total)
+        except Exception:
+            return None
+
+    def _sample_hardware(self, prev_cpu, cur_cpu) -> Dict[str, Any]:
+        """One reporter sample. TPU duty-cycle/HBM counters come from
+        libtpu's monitoring socket on real hosts; the chip free-list is
+        what this process authoritatively owns, so it is always present
+        (free == idle chips; a fully-busy node shows 0 free)."""
+        cpu_percent = None
+        if prev_cpu and cur_cpu and cur_cpu[1] > prev_cpu[1]:
+            cpu_percent = round(100.0 * (cur_cpu[0] - prev_cpu[0])
+                                / (cur_cpu[1] - prev_cpu[1]), 1)
+        mem_total = mem_avail = None
+        try:
+            with open("/proc/meminfo") as f:
+                info = {}
+                for line in f:
+                    k, v = line.split(":", 1)
+                    info[k] = int(v.split()[0]) * 1024
+            mem_total = info.get("MemTotal")
+            mem_avail = info.get("MemAvailable")
+        except Exception:
+            pass
+        try:
+            store = self.store.stats()
+        except Exception:
+            store = {}
+        with self._lock:
+            free_chips = len(self._free_tpu_chips)
+            workers = len(self._workers)
+        total_chips = int(self._total_resources.get("TPU", 0))
+        return {
+            "cpu_percent": cpu_percent,
+            "mem_total_bytes": mem_total,
+            "mem_available_bytes": mem_avail,
+            "store_used_bytes": store.get("used_bytes"),
+            "store_capacity_bytes": store.get("capacity_bytes"),
+            "store_objects": store.get("num_objects"),
+            "tpu_chips_total": total_chips,
+            "tpu_chips_free": free_chips,
+            "workers": workers,
+            "ts": time.time(),
+        }
 
     def _on_gcs_disconnect(self, conn):
         if self._shutdown:
@@ -766,16 +830,17 @@ class NodeManager:
             return self.gcs.request("kv_get", {
                 "ns": renv_mod.KV_NAMESPACE, "key": key}, timeout=60)
 
-        workdir, paths = renv_mod.ensure_runtime_env(kv_get, runtime_env,
-                                                     base)
+        workdir, paths, plugin_env = renv_mod.ensure_runtime_env(
+            kv_get, runtime_env, base)
         # working_dir is importable too (driver scripts import siblings).
         if workdir is not None:
             paths = [workdir] + paths
-        return workdir, paths
+        return workdir, paths, plugin_env
 
     def _lease_task_with_runtime_env(self, spec: TaskSpec):
         try:
-            cwd, pypaths = self._materialize_runtime_env(spec.runtime_env)
+            cwd, pypaths, plugin_env = self._materialize_runtime_env(
+                spec.runtime_env)
         except Exception as e:
             err = exceptions.RayTaskError(
                 getattr(spec, "name", ""),
@@ -799,7 +864,8 @@ class NodeManager:
                 for c in free:
                     self._free_tpu_chips.discard(c)
                 chips = free
-        env = dict((spec.runtime_env or {}).get("env_vars", {}))
+        env = dict(plugin_env)
+        env.update((spec.runtime_env or {}).get("env_vars", {}))
         w = self._spawn_worker(dedicated=True, env_extra=env, cwd=cwd,
                                extra_pythonpath=pypaths,
                                tpu_chips=chips or None)
@@ -854,8 +920,10 @@ class NodeManager:
                     daemon=True, name="rtpu-nm-renv").start()
                 return
             try:
-                cwd, pypaths = self._materialize_runtime_env(
+                cwd, pypaths, plugin_env = self._materialize_runtime_env(
                     spec.runtime_env)
+                # Plugin-provided env vars; explicit env_vars win.
+                env = {**plugin_env, **env}
             except Exception as e:
                 self.gcs.notify("actor_state", {
                     "actor_id": spec.actor_id.binary(), "state": "DEAD",

@@ -19,6 +19,57 @@ import zipfile
 from typing import Any, Dict, List, Optional, Tuple
 
 KV_NAMESPACE = "_runtime_env"
+
+
+class RuntimeEnvPlugin:
+    """Extension seam for new runtime_env keys (reference:
+    ``python/ray/_private/runtime_env/plugin.py:24,116`` — conda/pip/
+    container/working_dir are all plugins behind this protocol there).
+
+    A plugin owns one ``runtime_env`` key. Driver side, ``package``
+    rewrites the value into something shippable (e.g. upload a local
+    path to the GCS KV and return a URI). Node side, ``create``
+    materializes it and mutates the worker context: extra env vars,
+    sys.path entries, or the working dir. pip/conda/container support
+    plugs in here — this build gates them off (no package egress in the
+    target environment), but the seam is the reference-parity surface.
+    """
+
+    name: str = ""
+    priority: int = 10   # lower runs first (reference plugin priority)
+
+    def package(self, value: Any, kv) -> Any:
+        """Driver side: make the value location-independent."""
+        return value
+
+    def needs_isolation(self, value: Any) -> bool:
+        """True (default) if workers need a dedicated process for this
+        env. ``create()`` only runs on the isolated-worker path — return
+        False ONLY for plugins with no per-worker materialization at all
+        (driver-side ``package`` effects only)."""
+        return True
+
+    def create(self, value: Any, context: Dict[str, Any],
+               base_dir: str) -> None:
+        """Node side: materialize; mutate ``context`` —
+        {"env_vars": {}, "py_paths": [], "working_dir": None}."""
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin needs a name (its runtime_env key)")
+    _PLUGINS[plugin.name] = plugin
+
+
+def unregister_plugin(name: str) -> None:
+    _PLUGINS.pop(name, None)
+
+
+def _sorted_plugins():
+    return sorted(_PLUGINS.values(), key=lambda p: p.priority)
 URI_SCHEME = "kvzip://"
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_PACKAGE_BYTES = 256 * 1024 * 1024
@@ -152,25 +203,34 @@ def package_runtime_env(kv, runtime_env: Optional[Dict[str, Any]]
                 raise ValueError(f"runtime_env py_module {m!r} not found")
             out.append(cached_upload(m, _module_zip))
         env["py_modules"] = out
+    for plugin in _sorted_plugins():
+        if plugin.name in env:
+            env[plugin.name] = plugin.package(env[plugin.name], kv)
     return env
 
 
 def needs_isolation(runtime_env: Optional[Dict[str, Any]]) -> bool:
     """True when this env requires a dedicated worker (cwd / sys.path)."""
-    return bool(runtime_env and (runtime_env.get("working_dir")
-                                 or runtime_env.get("py_modules")))
+    if not runtime_env:
+        return False
+    if runtime_env.get("working_dir") or runtime_env.get("py_modules"):
+        return True
+    return any(p.needs_isolation(runtime_env[p.name])
+               for p in _sorted_plugins() if p.name in runtime_env)
 
 
 def ensure_runtime_env(kv_get, runtime_env: Optional[Dict[str, Any]],
-                       base_dir: str) -> Tuple[Optional[str], List[str]]:
+                       base_dir: str
+                       ) -> Tuple[Optional[str], List[str],
+                                  Dict[str, str]]:
     """Node side: materialize each URI once under ``base_dir/<hash>/``
-    (the URI cache) and return (working_dir_path, py_module_paths).
+    (the URI cache) and return (working_dir, py_paths, plugin_env_vars).
 
     ``kv_get(key: bytes) -> Optional[bytes]`` fetches from the GCS KV
     namespace ``_runtime_env``.
     """
     if not runtime_env:
-        return None, []
+        return None, [], {}
 
     def materialize(uri: str) -> str:
         h = uri[len(URI_SCHEME):]
@@ -200,4 +260,9 @@ def ensure_runtime_env(kv_get, runtime_env: Optional[Dict[str, Any]],
     for m in runtime_env.get("py_modules") or []:
         if isinstance(m, str) and m.startswith(URI_SCHEME):
             paths.append(materialize(m))
-    return workdir, paths
+    context: Dict[str, Any] = {"env_vars": {}, "py_paths": paths,
+                               "working_dir": workdir}
+    for plugin in _sorted_plugins():
+        if plugin.name in runtime_env:
+            plugin.create(runtime_env[plugin.name], context, base_dir)
+    return context["working_dir"], context["py_paths"], context["env_vars"]

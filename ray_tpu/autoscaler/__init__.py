@@ -11,9 +11,13 @@ bundles instead of loose chips.
 
 from ray_tpu.autoscaler.node_provider import NodeProvider  # noqa: F401
 from ray_tpu.autoscaler.fake_provider import FakeMultiNodeProvider  # noqa: F401
+from ray_tpu.autoscaler.tpu_pod_provider import (  # noqa: F401
+    FakeTpuCloud, TpuPodCloud, TpuPodProvider,
+)
 from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
     AutoscalerConfig, NodeType, StandardAutoscaler,
 )
 
 __all__ = ["NodeProvider", "FakeMultiNodeProvider", "StandardAutoscaler",
-           "AutoscalerConfig", "NodeType"]
+           "AutoscalerConfig", "NodeType", "TpuPodProvider", "TpuPodCloud",
+           "FakeTpuCloud"]

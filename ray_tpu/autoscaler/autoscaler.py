@@ -168,13 +168,31 @@ class StandardAutoscaler:
             self._idle_since.pop(gone, None)
         for nid in live:
             tags = self.provider.node_tags(nid)
-            gcs_id = tags.get("gcs-node-id")
-            info = nodes.get(gcs_id)
             nt_name = tags.get("node-type", "?")
             nt = next((t for t in self.config.node_types
                        if t.name == nt_name), None)
             first = self._first_seen.setdefault(nid, now)
-            if info is None or not info["Alive"]:
+            # A provider node may be ONE GCS node (tag "gcs-node-id") or a
+            # whole TPU slice — several hosts sharing a "slice" label
+            # (tpu_pod_provider): slice idleness is judged across ALL its
+            # hosts, and termination is always slice-atomic.
+            gcs_id = tags.get("gcs-node-id")
+            if gcs_id:
+                infos = [nodes.get(gcs_id)]
+            elif tags.get("slice"):
+                infos = [n for n in nodes.values()
+                         if n.get("Labels", {}).get("slice")
+                         == tags["slice"]]
+            else:
+                infos = []
+            alive_infos = [i for i in infos if i and i["Alive"]]
+            if not alive_infos:
+                # A queued-resources request still WAITING_FOR_RESOURCES
+                # has no hosts yet and may wait arbitrarily long for
+                # cloud capacity — not a boot failure.
+                if tags.get("state") == "WAITING_FOR_RESOURCES":
+                    self._first_seen[nid] = now
+                    continue
                 # Never registered (still booting?) or died: terminate once
                 # the boot grace expires so the instance doesn't leak.
                 if now - first >= self.config.boot_grace_s:
@@ -186,7 +204,8 @@ class StandardAutoscaler:
                     counts[nt_name] = counts.get(nt_name, 1) - 1
                     terminated += 1
                 continue
-            idle = info["Resources"] == info["Available"]
+            idle = all(i["Resources"] == i["Available"]
+                       for i in alive_infos)
             if not idle:
                 self._idle_since.pop(nid, None)
                 continue

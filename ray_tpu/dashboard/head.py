@@ -61,6 +61,10 @@ class DashboardHead:
 
         app = web.Application()
         app.router.add_get("/", self._index)
+        app.router.add_get("/api/serve/applications",
+                           self._serve_applications_get)
+        app.router.add_put("/api/serve/applications",
+                           self._serve_applications_put)
         app.router.add_get("/api/{what}", self._api)
         app.router.add_get("/metrics", self._metrics)
         runner = web.AppRunner(app)
@@ -111,6 +115,70 @@ class DashboardHead:
         return web.Response(text=json.dumps(data, default=repr),
                             content_type="application/json")
 
+    async def _serve_applications_get(self, request):
+        """Serve app status (reference: dashboard/modules/serve/ REST —
+        GET /api/serve/applications/)."""
+        from aiohttp import web
+
+        loop = asyncio.get_running_loop()
+
+        def fetch():
+            from ray_tpu import serve
+
+            return {"applications": serve.status()}
+
+        data = await loop.run_in_executor(None, fetch)
+        return web.json_response(data)
+
+    async def _serve_applications_put(self, request):
+        """Declarative config deploy (reference: serve REST `serve deploy`
+        — dashboard/modules/serve/serve_head.py + serve/schema.py
+        ServeDeploySchema). Body:
+        {"applications": [{"name", "import_path": "module:attr",
+                           "route_prefix", "num_replicas", ...}]}.
+        ``import_path`` resolves to a Deployment or a bound Application
+        on the head; deploy-by-config is idempotent (re-PUT = code push).
+        """
+        from aiohttp import web
+
+        body = await request.json()
+        loop = asyncio.get_running_loop()
+
+        def apply():
+            import importlib
+
+            from ray_tpu import serve
+            from ray_tpu.serve.api import Application, Deployment
+
+            deployed = []
+            for spec in body.get("applications", []):
+                mod_name, _, attr = spec["import_path"].partition(":")
+                target = getattr(importlib.import_module(mod_name), attr)
+                if isinstance(target, Deployment):
+                    overrides = {k: spec[k] for k in
+                                 ("num_replicas", "max_ongoing_requests",
+                                  "user_config") if k in spec}
+                    if overrides:
+                        target = target.options(**overrides)
+                    target = target.bind(*spec.get("args", ()))
+                if not isinstance(target, Application):
+                    raise TypeError(
+                        f"{spec['import_path']} is not a Deployment or "
+                        f"bound Application")
+                serve.run(target, name=spec.get("name"),
+                          route_prefix=spec.get("route_prefix"),
+                          http_port=spec.get("http_port", 8000))
+                deployed.append(spec.get("name")
+                                or target.deployment.name)
+            return deployed
+
+        try:
+            deployed = await loop.run_in_executor(None, apply)
+        except Exception as e:
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=400)
+        return web.json_response({"deployed": deployed})
+
     async def _metrics(self, request):
         from aiohttp import web
         from ray_tpu._private import worker as worker_mod
@@ -136,6 +204,34 @@ class DashboardHead:
         out = [{"name": "ray_tpu_cluster_nodes_alive",
                 "tags": {}, "value": sum(1 for n in nodes if n["Alive"]),
                 "kind": "gauge", "help": "alive nodes"}]
+        # Per-node reporter gauges (reference: reporter_agent.py:253 —
+        # node CPU/mem/GPU stats; TPU-first leads with chip occupancy
+        # and object-store pressure).
+        hw_gauges = [
+            ("cpu_percent", "ray_tpu_node_cpu_percent", "node CPU %"),
+            ("mem_available_bytes", "ray_tpu_node_mem_available_bytes",
+             "node memory available"),
+            ("mem_total_bytes", "ray_tpu_node_mem_total_bytes",
+             "node memory total"),
+            ("store_used_bytes", "ray_tpu_node_store_used_bytes",
+             "object store used"),
+            ("store_capacity_bytes", "ray_tpu_node_store_capacity_bytes",
+             "object store capacity"),
+            ("tpu_chips_free", "ray_tpu_node_tpu_chips_free",
+             "idle TPU chips"),
+            ("tpu_chips_total", "ray_tpu_node_tpu_chips_total",
+             "TPU chips on node"),
+            ("workers", "ray_tpu_node_workers", "worker processes"),
+        ]
+        for n in nodes:
+            hw = n.get("Hardware") or {}
+            node12 = n["NodeID"][:12]
+            for key, metric, help_text in hw_gauges:
+                v = hw.get(key)
+                if v is not None:
+                    out.append({"name": metric,
+                                "tags": {"node": node12}, "value": v,
+                                "kind": "gauge", "help": help_text})
         for k, v in total.items():
             if k.startswith("node:"):
                 continue

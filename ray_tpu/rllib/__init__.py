@@ -25,6 +25,16 @@ from ray_tpu.rllib.a2c import A2C, A2CConfig, A2CLearner  # noqa: F401
 from ray_tpu.rllib.impala import (  # noqa: F401
     IMPALA, IMPALAConfig, IMPALALearner,
 )
+from ray_tpu.rllib.offline import (  # noqa: F401
+    BC, BCConfig, BCLearner, JsonReader, JsonWriter,
+)
+from ray_tpu.rllib.multi_agent import (  # noqa: F401
+    MultiAgentPPO, MultiAgentPPOConfig,
+)
+from ray_tpu.rllib.sac import (  # noqa: F401
+    SAC, SACConfig, SACLearner, ContinuousPolicySpec, ContinuousReplayBuffer,
+    GaussianPolicy,
+)
 from ray_tpu.rllib.dqn import (  # noqa: F401
     DQN, DQNConfig, DQNLearner, ReplayBuffer,
 )

@@ -70,7 +70,16 @@ class AlgorithmConfig:
                 f"{type(self).__name__}.environment(env_creator) required")
         probe = self.env_creator()
         self.obs_dim = int(np.prod(probe.observation_space.shape))
-        self.num_actions = int(probe.action_space.n)
+        act = probe.action_space
+        if hasattr(act, "n"):
+            self.num_actions = int(act.n)
+        else:
+            # Continuous (Box) space: discrete head unused; algorithms
+            # like SAC build their own continuous policy spec from the
+            # recorded bounds (one probe env total).
+            self.num_actions = int(np.prod(act.shape))
+            self.action_low = float(np.min(act.low))
+            self.action_high = float(np.max(act.high))
         close = getattr(probe, "close", None)
         if close:
             close()

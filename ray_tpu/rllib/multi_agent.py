@@ -1,0 +1,250 @@
+"""Multi-agent training: policy maps + shared environment stepping
+(reference: ``rllib/env/multi_agent_env.py`` MultiAgentEnv protocol;
+``policy_mapping_fn`` + per-policy train batches in
+``rllib/algorithms/algorithm_config.py`` multi_agent()).
+
+Environment protocol (dict-keyed by agent id):
+    reset(seed=...) -> (obs_dict, info_dict)
+    step(action_dict) -> (obs_dict, reward_dict, terminated_dict,
+                          truncated_dict, info_dict)
+``terminated_dict["__all__"]`` ends the episode for everyone.
+
+Each named policy is an independent PPO learner; the rollout loop steps
+ONE shared env, routes every agent's experience to its policy via
+``policy_mapping_fn``, computes per-agent GAE at episode end, and each
+``train()`` runs one PPO update per policy on its own batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
+from ray_tpu.rllib.ppo import PPOConfig, PPOLearner
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS, SampleBatch, compute_gae,
+    concat_batches,
+)
+
+
+@dataclasses.dataclass
+class MultiAgentPPOConfig(AlgorithmConfig):
+    # name -> PolicySpec; agents map onto these via policy_mapping_fn.
+    policies: Optional[Dict[str, PolicySpec]] = None
+    policy_mapping_fn: Optional[Callable[[str], str]] = None
+    lr: float = 3e-4
+    clip_param: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_sgd_epochs: int = 4
+    sgd_minibatch_size: int = 128
+    lam: float = 0.95
+
+    def multi_agent(self, *, policies: Dict[str, PolicySpec],
+                    policy_mapping_fn: Callable[[str], str]
+                    ) -> "MultiAgentPPOConfig":
+        self.policies = policies
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def infer_spaces(self) -> None:
+        # Spaces come from the per-policy specs, not a probe env.
+        self.obs_dim = self.obs_dim or 1
+        self.num_actions = self.num_actions or 1
+
+
+class _MultiAgentRolloutWorker:
+    """Steps one shared multi-agent env; emits per-POLICY batches."""
+
+    def __init__(self, env_creator: Callable,
+                 policies: Dict[str, PolicySpec],
+                 mapping_blob: bytes,
+                 gamma: float, lam: float,
+                 fragment_length: int, seed: int):
+        import cloudpickle
+        import jax
+
+        self.env = env_creator()
+        self.policies = policies
+        self.mapping = cloudpickle.loads(mapping_blob)
+        self.gamma, self.lam = gamma, lam
+        self.fragment = fragment_length
+        self._rng = jax.random.key(seed)
+        self._reset(seed)
+        self._returns: List[float] = []
+
+    def _reset(self, seed: Optional[int] = None):
+        self._obs, _ = self.env.reset(seed=seed)
+        # agent -> per-episode trajectory columns
+        self._traj: Dict[str, Dict[str, list]] = {}
+        self._ep_return = 0.0
+
+    def sample(self, weights: Dict[str, Any]) -> Dict[str, Any]:
+        import jax
+        import numpy as np
+
+        out_rows: Dict[str, List[SampleBatch]] = {p: []
+                                                  for p in self.policies}
+        steps = 0
+        while steps < self.fragment:
+            actions: Dict[str, Any] = {}
+            cache: Dict[str, tuple] = {}
+            for agent, obs in self._obs.items():
+                pol = self.mapping(agent)
+                self._rng, sub = jax.random.split(self._rng)
+                a, logp, v = MLPPolicy.sample_action(
+                    weights[pol], np.asarray(obs, np.float32)[None], sub)
+                actions[agent] = int(a[0])
+                cache[agent] = (float(logp[0]), float(v[0]), obs)
+            nxt, rew, term, trunc, _ = self.env.step(actions)
+            steps += len(actions)
+            for agent, act in actions.items():
+                logp, v, obs = cache[agent]
+                t = self._traj.setdefault(agent, {
+                    "obs": [], "act": [], "logp": [], "val": [],
+                    "rew": [], "done": []})
+                done = bool(term.get(agent) or term.get("__all__"))
+                t["obs"].append(np.asarray(obs, np.float32))
+                t["act"].append(act)
+                t["logp"].append(logp)
+                t["val"].append(v)
+                t["rew"].append(float(rew.get(agent, 0.0)))
+                t["done"].append(done)
+                self._ep_return += float(rew.get(agent, 0.0))
+            episode_over = bool(term.get("__all__")
+                                or trunc.get("__all__"))
+            if episode_over:
+                # Advance to the FINAL observation first so a truncated
+                # (not terminated) episode bootstraps from V(s_{t+1}).
+                self._obs = nxt
+                self._flush_episode(out_rows, weights)
+                self._returns.append(self._ep_return)
+                self._reset()
+            else:
+                self._obs = nxt
+        self._flush_episode(out_rows, weights)   # bootstrap mid-episode
+        batches = {p: dict(concat_batches(rows)) if rows else None
+                   for p, rows in out_rows.items()}
+        returns, self._returns = self._returns, []
+        return {"batches": batches, "steps": steps,
+                "episode_returns": returns}
+
+    def _flush_episode(self, out_rows, weights):
+        import numpy as np
+
+        for agent, t in self._traj.items():
+            if not t["act"]:
+                continue
+            pol = self.mapping(agent)
+            last_done = t["done"][-1]
+            if last_done or agent not in self._obs:
+                last_value = 0.0
+            else:
+                _, v = MLPPolicy.forward(
+                    weights[pol],
+                    np.asarray(self._obs[agent], np.float32)[None])
+                last_value = float(v[0])
+            adv, ret = compute_gae(
+                np.asarray(t["rew"], np.float32),
+                np.asarray(t["val"], np.float32),
+                np.asarray(t["done"]), last_value,
+                self.gamma, self.lam)
+            out_rows[pol].append(SampleBatch({
+                OBS: np.stack(t["obs"]),
+                ACTIONS: np.asarray(t["act"], np.int32),
+                LOGPS: np.asarray(t["logp"], np.float32),
+                ADVANTAGES: adv, RETURNS: ret,
+            }))
+        self._traj = {}
+
+
+class MultiAgentPPO(Algorithm):
+    def setup(self) -> None:
+        import cloudpickle
+
+        import ray_tpu
+
+        config = self.config
+        if not config.policies or config.policy_mapping_fn is None:
+            raise ValueError("multi_agent(policies=..., "
+                             "policy_mapping_fn=...) required")
+        ppo_cfg = PPOConfig(
+            lr=config.lr, clip_param=config.clip_param,
+            vf_coeff=config.vf_coeff,
+            entropy_coeff=config.entropy_coeff, seed=config.seed)
+        self.learners: Dict[str, PPOLearner] = {
+            name: PPOLearner(spec, ppo_cfg)
+            for name, spec in config.policies.items()}
+        self.learner = next(iter(self.learners.values()))  # ckpt anchor
+        mapping_blob = cloudpickle.dumps(config.policy_mapping_fn)
+        worker_cls = ray_tpu.remote(_MultiAgentRolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=1).remote(
+                config.env_creator, config.policies, mapping_blob,
+                config.gamma, config.lam,
+                config.rollout_fragment_length, config.seed + 1 + i)
+            for i in range(config.num_rollout_workers)
+        ]
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        weights = {n: lr.get_weights() for n, lr in self.learners.items()}
+        outs = ray_tpu.get([w.sample.remote(weights)
+                            for w in self.workers])
+        steps = sum(o["steps"] for o in outs)
+        returns = [r for o in outs for r in o["episode_returns"]]
+        metrics: Dict[str, Any] = {"timesteps_this_iter": steps}
+        rng = self._np_rng
+        for name, learner in self.learners.items():
+            parts = [SampleBatch(o["batches"][name]) for o in outs
+                     if o["batches"].get(name) is not None]
+            if not parts:
+                continue
+            batch = concat_batches(parts)
+            m = learner.update_from_batch(
+                batch, num_epochs=self.config.num_sgd_epochs,
+                minibatch_size=self.config.sgd_minibatch_size, rng=rng)
+            for k, v in m.items():
+                metrics[f"{name}/{k}"] = v
+        metrics["episode_return_mean"] = (
+            float(np.mean(returns)) if returns else None)
+        return metrics
+
+    # Multi-policy checkpoint state.
+    def save_checkpoint(self, path: str) -> str:
+        import os
+
+        import cloudpickle
+
+        os.makedirs(path, exist_ok=True)
+        fp = os.path.join(path, "algorithm_state.pkl")
+        with open(fp, "wb") as f:
+            cloudpickle.dump({
+                "learners": {n: lr.get_state()
+                             for n, lr in self.learners.items()},
+                "iteration": self.iteration,
+                "timesteps_total": self.timesteps_total,
+            }, f)
+        return fp
+
+    def restore_checkpoint(self, path: str) -> None:
+        import os
+
+        import cloudpickle
+
+        fp = path if path.endswith(".pkl") else os.path.join(
+            path, "algorithm_state.pkl")
+        with open(fp, "rb") as f:
+            state = cloudpickle.load(f)
+        for n, s in state["learners"].items():
+            self.learners[n].set_state(s)
+        self.iteration = state["iteration"]
+        self.timesteps_total = state["timesteps_total"]
+
+
+MultiAgentPPOConfig._algo_cls = MultiAgentPPO

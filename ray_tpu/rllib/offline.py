@@ -1,0 +1,171 @@
+"""Offline RL: experience IO + behavior cloning (reference:
+``rllib/offline/`` — ``json_writer.py`` / ``json_reader.py`` experience
+shards, and ``rllib/algorithms/bc`` behavior cloning, the canonical
+dataset-only baseline).
+
+Experiences are JSONL shards of SampleBatch columns; readers stream
+them back as batches, composable with ``ray_tpu.data`` for distributed
+reads (a shard is just a JSON file). Online algorithms record via
+``output_path`` in their config? — here recording is explicit:
+``JsonWriter.write(batch)`` from any rollout loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as glob_mod
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, Learner
+from ray_tpu.rllib.policy import MLPPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import ACTIONS, OBS, SampleBatch
+
+
+class JsonWriter:
+    """Append SampleBatches to JSONL shards (reference: json_writer.py —
+    one JSON object per batch, columns as lists)."""
+
+    def __init__(self, path: str, max_shard_bytes: int = 64 * 1024 * 1024):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._shard_idx = 0
+        self._bytes = 0
+        self._max = max_shard_bytes
+        self._f = None
+
+    def _open(self):
+        if self._f is None or self._bytes >= self._max:
+            if self._f is not None:
+                self._f.close()
+                self._shard_idx += 1
+                self._bytes = 0
+            self._f = open(os.path.join(
+                self.path, f"shard-{self._shard_idx:05d}.jsonl"), "a")
+        return self._f
+
+    def write(self, batch) -> None:
+        row = {k: np.asarray(v).tolist() for k, v in dict(batch).items()}
+        line = json.dumps(row) + "\n"
+        f = self._open()
+        f.write(line)
+        f.flush()
+        self._bytes += len(line)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class JsonReader:
+    """Stream SampleBatches back from JSONL shards."""
+
+    def __init__(self, path: str):
+        if os.path.isdir(path):
+            self.files = sorted(glob_mod.glob(os.path.join(path,
+                                                           "*.jsonl")))
+        else:
+            self.files = sorted(glob_mod.glob(path))
+        if not self.files:
+            raise FileNotFoundError(f"no experience shards at {path!r}")
+
+    def __iter__(self) -> Iterator[SampleBatch]:
+        for fp in self.files:
+            with open(fp) as f:
+                for line in f:
+                    if line.strip():
+                        row = json.loads(line)
+                        yield SampleBatch({k: np.asarray(v)
+                                           for k, v in row.items()})
+
+    def read_all(self) -> SampleBatch:
+        from ray_tpu.rllib.sample_batch import concat_batches
+
+        return concat_batches(list(self))
+
+
+@dataclasses.dataclass
+class BCConfig(AlgorithmConfig):
+    """Behavior cloning from a recorded dataset (reference:
+    rllib/algorithms/bc — maximize log-likelihood of dataset actions).
+    ``input_path``: JSONL experience shards. The env is only used for
+    space inference and optional evaluation rollouts."""
+
+    input_path: str = ""
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    sgd_iters_per_step: int = 32
+    evaluation_episodes: int = 0   # >0: greedy rollouts each train()
+
+
+class BCLearner(Learner):
+    def __init__(self, spec: PolicySpec, config: BCConfig):
+        import jax
+        import jax.numpy as jnp
+
+        def loss_fn(params, batch):
+            logits, _ = MLPPolicy.forward(params, batch[OBS])
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, batch[ACTIONS][:, None].astype(jnp.int32),
+                axis=1)[:, 0].mean()
+            return nll, {"bc_loss": nll}
+
+        super().__init__(spec, config, loss_fn)
+
+
+class BC(Algorithm):
+    """Dataset-only training: no rollout workers in the loop."""
+
+    def setup(self) -> None:
+        config = self.config
+        self.learner = BCLearner(self.spec, config)
+        data = JsonReader(config.input_path).read_all()
+        self._obs = np.asarray(data[OBS], np.float32)
+        self._actions = np.asarray(data[ACTIONS], np.int32)
+
+    def training_step(self) -> Dict[str, Any]:
+        n = len(self._actions)
+        bs = min(self.config.train_batch_size, n)
+        metrics: Dict[str, Any] = {}
+        for _ in range(self.config.sgd_iters_per_step):
+            idx = self._np_rng.integers(0, n, bs)
+            metrics = self.learner.step({
+                OBS: self._obs[idx], ACTIONS: self._actions[idx]})
+        out = {"timesteps_this_iter": bs
+               * self.config.sgd_iters_per_step, **metrics}
+        if self.config.evaluation_episodes:
+            out["evaluation_return_mean"] = self.evaluate(
+                self.config.evaluation_episodes)
+        return out
+
+    def evaluate(self, episodes: int) -> float:
+        """Greedy rollouts of the cloned policy (offline evaluation)."""
+        import jax.numpy as jnp
+
+        env = self.config.env_creator()
+        returns = []
+        for ep in range(episodes):
+            obs, _ = env.reset(seed=1000 + ep)
+            done, total = False, 0.0
+            while not done:
+                logits, _ = MLPPolicy.forward(
+                    self.learner.params,
+                    jnp.asarray(np.asarray(obs, np.float32))[None])
+                a = int(np.argmax(np.asarray(logits)[0]))
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        try:
+            env.close()
+        except Exception:
+            pass
+        return float(np.mean(returns))
+
+
+BCConfig._algo_cls = BC

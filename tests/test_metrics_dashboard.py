@@ -86,3 +86,32 @@ def test_dashboard_rest(ray_cluster):
     prom = get("/metrics")
     assert "ray_tpu_cluster_nodes_alive 1" in prom
     assert 'ray_tpu_cluster_resource_total{resource="CPU"} 4.0' in prom
+
+
+def test_node_hardware_reporter(ray_cluster):
+    """Per-node reporter samples (reference: reporter_agent.py:253) flow
+    heartbeat -> GCS -> nodes API + /metrics gauges."""
+    import time as _t
+
+    from ray_tpu.dashboard import start_dashboard
+
+    deadline = _t.time() + 15
+    hw = {}
+    while _t.time() < deadline:
+        nodes = ray_tpu.nodes()
+        hw = (nodes[0].get("Hardware") or {}) if nodes else {}
+        if hw.get("store_capacity_bytes"):
+            break
+        _t.sleep(0.3)
+    assert hw.get("store_capacity_bytes"), hw
+    assert hw.get("mem_total_bytes")
+    assert "tpu_chips_free" in hw and "workers" in hw
+
+    try:
+        _actor, port = start_dashboard(port=18266)
+    except Exception:
+        port = 18265   # test_dashboard_rest already started one
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=15).read().decode()
+    assert "ray_tpu_node_store_capacity_bytes" in text
+    assert "ray_tpu_node_mem_total_bytes" in text

@@ -116,3 +116,54 @@ def test_env_vars_still_honored_with_working_dir(ray_2cpu, tmp_path):
         return os.environ.get("SHIPPED_FLAG"), os.path.exists("x.txt")
 
     assert ray_tpu.get(probe.remote(), timeout=60) == ("on", True)
+
+
+def test_runtime_env_plugin_api(ray_2cpu):
+    """The plugin seam (reference: runtime_env/plugin.py:24,116): a
+    custom key is packaged driver-side and materialized node-side into
+    worker env vars + sys.path — the mechanism conda/pip/container
+    support plugs into."""
+    import os
+
+    import ray_tpu
+    from ray_tpu._private import runtime_env as renv
+
+    class StampPlugin(renv.RuntimeEnvPlugin):
+        name = "stamp"
+
+        def package(self, value, kv):
+            return {"packaged": True, **value}
+
+        def needs_isolation(self, value):
+            return True
+
+        def create(self, value, context, base_dir):
+            assert value["packaged"]   # went through package()
+            context["env_vars"]["RTPU_STAMP"] = value["tag"]
+            d = os.path.join(base_dir, "stamp_dir")
+            os.makedirs(d, exist_ok=True)
+            context["py_paths"].append(d)
+
+    renv.register_plugin(StampPlugin())
+    try:
+        @ray_tpu.remote(runtime_env={"stamp": {"tag": "hello-plugin"}})
+        def read():
+            import os
+            import sys
+            return (os.environ.get("RTPU_STAMP"),
+                    any(p.endswith("stamp_dir") for p in sys.path))
+
+        tag, on_path = ray_tpu.get(read.remote(), timeout=60)
+        assert tag == "hello-plugin"
+        assert on_path
+
+        # Explicit env_vars beat plugin-provided ones.
+        @ray_tpu.remote(runtime_env={"stamp": {"tag": "x"},
+                                     "env_vars": {"RTPU_STAMP": "explicit"}})
+        def read2():
+            import os
+            return os.environ.get("RTPU_STAMP")
+
+        assert ray_tpu.get(read2.remote(), timeout=60) == "explicit"
+    finally:
+        renv.unregister_plugin("stamp")

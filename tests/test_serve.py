@@ -289,3 +289,49 @@ def test_replica_death_detected_via_actor_events(serve_cluster):
     current = ray_tpu.get(ctrl.get_replicas.remote("Echo"))
     assert len(current) == 2 and replicas[0] not in current
     assert handle.remote(7).result(timeout=30) == 7
+
+
+def test_serve_rest_config_deploy(serve_cluster, tmp_path, monkeypatch):
+    """Declarative REST deploy (reference: dashboard/modules/serve/ +
+    serve/schema.py): PUT a config with an import_path, GET status."""
+    import json as _json
+    import sys
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    mod_dir = tmp_path / "serve_rest_mod"
+    mod_dir.mkdir()
+    (mod_dir / "my_rest_app.py").write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "class Greeter:\n"
+        "    def __call__(self, name):\n"
+        "        return f'hi {name}'\n"
+        "app = Greeter.bind()\n")
+    monkeypatch.syspath_prepend(str(mod_dir))
+    sys.modules.pop("my_rest_app", None)
+
+    try:
+        _a, port = start_dashboard(port=18267)
+    except Exception:
+        port = 18265
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/serve/applications",
+        data=_json.dumps({"applications": [{
+            "name": "Greeter", "import_path": "my_rest_app:app",
+            "http_port": None}]}).encode(),
+        method="PUT", headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = _json.loads(r.read())
+    assert out == {"deployed": ["Greeter"]}
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/serve/applications",
+            timeout=30) as r:
+        status = _json.loads(r.read())
+    assert "Greeter" in status["applications"]
+
+    from ray_tpu import serve
+    h = serve.get_deployment_handle("Greeter")
+    assert h.remote("rest").result(timeout=60) == "hi rest"
