@@ -235,6 +235,8 @@ class GcsServer:
         self._pending_free: Dict[bytes, float] = {}       # oid -> deadline
         self._task_arg_pins: Dict[bytes, int] = collections.defaultdict(int)
         self._pinned_tasks: Set[bytes] = set()            # task ids holding pins
+        # Rerouted actor-task specs whose args the GCS pins until done.
+        self._actor_task_pins: Dict[bytes, Any] = {}
         # Lineage: retained specs for resubmission + attempt caps.
         self._task_specs: Dict[bytes, TaskSpec] = {}
         self._reconstructions: Dict[bytes, int] = {}      # task_id -> attempts
@@ -483,6 +485,7 @@ class GcsServer:
             return
         node.alive = False
         logger.warning("node %s died", node_id)
+        self._drop_client_refs(f"node:{node_id[:12]}")
         # Leases on the dead node die with it (resources went with the node;
         # holders notice their direct conns closing and fall back).
         for lid, lease in list(self._leases.items()):
@@ -873,6 +876,9 @@ class GcsServer:
             if entry is not None:
                 spec, node_id = entry
                 self._release_for(spec, node_id)
+            pinned_spec = self._actor_task_pins.pop(tid, None)
+            if pinned_spec is not None:
+                self._unpin_task_args(pinned_spec)
             for oid, size in p.get("objects", []):
                 self._add_location(oid, p["node_id"], size)
             if entry is not None and \
@@ -1000,6 +1006,7 @@ class GcsServer:
     def _fail_task_objects(self, spec, reason: str):
         """Ask the owner's node to materialize error objects for the returns."""
         self._unpin_task_args(spec)
+        self._actor_task_pins.pop(spec.task_id.binary(), None)
         owner_node = self._nodes.get(getattr(spec, "owner_node", None)) or next(
             (n for n in self._nodes.values() if n.alive), None)
         ids = [r.binary() for r in spec.return_ids()]
@@ -1435,17 +1442,24 @@ class GcsServer:
                     spec, entry.death_cause or "actor died")
 
     def _h_reroute_actor_task(self, conn, spec: ActorTaskSpec, msg_id):
-        """An actor task arrived at a node no longer hosting the actor."""
+        """An actor task arrived at a node no longer hosting the actor.
+
+        The spec's args are pinned here (the rerouting caller released
+        its pin) until the task completes — _h_task_done unpins via
+        _actor_task_pins — or fails (_fail_task_objects unpins)."""
         with self._lock:
             entry = self._actors.get(spec.actor_id.binary())
             if entry is None or entry.state == DEAD:
                 cause = entry.death_cause if entry else "actor not found"
                 self._fail_task_objects(spec, cause or "actor died")
-            elif entry.state == ALIVE and entry.node_id in self._nodes:
-                self._nodes[entry.node_id].conn.notify(
-                    "submit_actor_task", spec)
             else:
-                entry.pending_tasks.append(spec)
+                self._pin_task_args(spec)
+                self._actor_task_pins[spec.task_id.binary()] = spec
+                if entry.state == ALIVE and entry.node_id in self._nodes:
+                    self._nodes[entry.node_id].conn.notify(
+                        "submit_actor_task", spec)
+                else:
+                    entry.pending_tasks.append(spec)
 
     def _actor_info(self, entry: ActorEntry) -> dict:
         node = self._nodes.get(entry.node_id) if entry.node_id else None

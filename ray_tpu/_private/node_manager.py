@@ -618,6 +618,9 @@ class NodeManager:
                 self._free_tpu_chips.add(chip)
             tasks = dict(w.current_tasks)
             w.current_tasks.clear()
+            parked_actor_specs = [p for (mt, p) in w.pending_pushes
+                                  if mt == "run_actor_task"]
+            w.pending_pushes = []
             actor_id = w.actor_id
             lease_reply, w.lease_reply = w.lease_reply, None
         if lease_reply is not None:
@@ -628,6 +631,10 @@ class NodeManager:
                 lconn.reply_error(lmsg_id, "leased worker died at startup")
             except protocol.ConnectionClosed:
                 pass
+        for spec in parked_actor_specs:
+            # Never delivered; the reroute path (below, via current_tasks)
+            # or failure materialization takes custody of the args.
+            self._refcount_delta(spec.arg_deps, -1)
         # Fail in-flight tasks. Plain tasks: report crashed WITHOUT
         # materializing error objects — the GCS owns the retry budget, and
         # an early error object would fulfill the caller's get() with the
@@ -999,6 +1006,18 @@ class NodeManager:
             except protocol.ConnectionClosed:
                 pass
 
+    def _refcount_delta(self, deps, delta: int) -> None:
+        """Pin/unpin object deps under this NODE's refcount identity
+        (dropped wholesale by the GCS if this node dies)."""
+        if not deps:
+            return
+        try:
+            self.gcs.notify("update_refcounts", {
+                "client_id": f"node:{self.node_id[:12]}",
+                "deltas": {d.binary(): delta for d in deps}})
+        except Exception:
+            pass
+
     def _on_submit_actor_task(self, spec: ActorTaskSpec):
         aid = spec.actor_id.binary()
         with self._lock:
@@ -1006,6 +1025,11 @@ class NodeManager:
             if w is not None and w.state != "dead":
                 w.current_tasks[spec.task_id.binary()] = spec
                 if w.conn is None:
+                    # Parked until the actor's worker registers: pin the
+                    # args under the NODE identity for the parked window
+                    # (the worker pins on receive; the caller's pin was
+                    # released at ack).
+                    self._refcount_delta(spec.arg_deps, +1)
                     w.pending_pushes.append(("run_actor_task", spec))
                     return
                 conn = w.conn
@@ -1151,6 +1175,10 @@ class NodeManager:
             except protocol.ConnectionClosed:
                 self._on_worker_death(w)
                 return
+            if mtype == "run_actor_task":
+                # Delivered: the worker's receive-time pin owns the args
+                # now; release the parked-window node pin.
+                self._refcount_delta(payload.arg_deps, -1)
         self._dispatch_queued()
 
     def _on_lease_worker(self, conn, p, msg_id):

@@ -1016,6 +1016,14 @@ class CoreWorker:
                           args, kwargs, *, num_returns: int = 1,
                           concurrency_group: str = "") -> List[ObjectRef]:
         args_blob, deps = self._serialize_args(args, kwargs)
+        # Pin arg deps while the spec is in OUR hands (parked on a route,
+        # in flight to the NM): the ack hand-off transfers custody to the
+        # receiving side's pins (worker on receive; NM while parked; GCS
+        # for reroutes), so a caller that drops its ObjectRefs right after
+        # .remote() can never get its args freed mid-flight.
+        if self._refs is not None:
+            for d in deps:
+                self._refs.incref(d.binary())
         aid = actor_id.binary()
         task_id = TaskID.for_actor_task(actor_id)
         with self._actor_lock:
@@ -1091,7 +1099,15 @@ class CoreWorker:
                 # inline under _actor_lock (future already done) or on the
                 # conn's serve thread, and _repark_actor_task takes the lock.
                 self._route_submit(self._repark_actor_task, spec)
+            else:
+                # Delivered: the receiver's pins own the args now.
+                self._decref_actor_task_deps(spec)
         return on_ack
+
+    def _decref_actor_task_deps(self, spec):
+        if self._refs is not None:
+            for d in spec.arg_deps:
+                self._refs.decref(d.binary())
 
     def _repark_actor_task(self, spec):
         aid = spec.actor_id.binary()
@@ -1150,12 +1166,14 @@ class CoreWorker:
                     route["address"] = addr
             else:
                 unsent = pending
-        # Dead or unreachable: let the GCS materialize / reroute.
+        # Dead or unreachable: let the GCS materialize / reroute (its
+        # handler pins the args; release our submit-time pin).
         for spec in unsent:
             try:
                 self.gcs.notify("reroute_actor_task", spec)
             except Exception:
                 pass
+            self._decref_actor_task_deps(spec)
 
     def resolve_actor_blocking(self, actor_id: ActorID,
                                timeout: Optional[float] = None) -> dict:

@@ -90,7 +90,24 @@ class WorkerExecutor:
         # Node manager went away: nothing to live for.
         os._exit(0)
 
+    def _pin_actor_task_args(self, spec):
+        refs = self.core._refs
+        if refs is not None:
+            for d in spec.arg_deps:
+                refs.incref(d.binary())
+
+    def _unpin_actor_task_args(self, spec):
+        refs = self.core._refs
+        if refs is not None:
+            for d in spec.arg_deps:
+                refs.decref(d.binary())
+
     def _on_msg(self, conn, mtype, payload, msg_id):
+        if mtype == "run_actor_task":
+            # Pin args the moment the spec lands here: the task may sit in
+            # this actor's queue for a long time, and the caller's refs may
+            # be long gone by then (custody chain: caller -> here -> done).
+            self._pin_actor_task_args(payload)
         if mtype == "cancel_task":
             self._handle_cancel(payload["task_id"])
             return
@@ -488,6 +505,12 @@ class WorkerExecutor:
         return not dup
 
     def _execute_actor_task(self, spec: ActorTaskSpec):
+        try:
+            self._execute_actor_task_inner(spec)
+        finally:
+            self._unpin_actor_task_args(spec)
+
+    def _execute_actor_task_inner(self, spec: ActorTaskSpec):
         if not self._claim_seqno(spec):
             return
         self._current_task_id = spec.task_id.binary()
@@ -527,6 +550,12 @@ class WorkerExecutor:
             self._delayed_exit()
 
     async def _run_actor_task_async(self, spec: ActorTaskSpec):
+        try:
+            await self._run_actor_task_async_inner(spec)
+        finally:
+            self._unpin_actor_task_args(spec)
+
+    async def _run_actor_task_async_inner(self, spec: ActorTaskSpec):
         if not self._claim_seqno(spec):
             return
         async with self._aio_sem:

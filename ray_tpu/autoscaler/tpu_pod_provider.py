@@ -67,6 +67,9 @@ class TpuPodProvider(NodeProvider):
         # Desired state: name -> request dict. Reconcile diffs this
         # against the cloud listing with one batch per direction.
         self._desired: Dict[str, dict] = {}
+        # Last listing from this tick's reconcile: node_tags/is_running
+        # serve from it so an autoscaler tick stays O(1) cloud calls.
+        self._listing: Dict[str, dict] = {}
 
     # ------------------------------------------------------- reconcile
 
@@ -85,7 +88,11 @@ class TpuPodProvider(NodeProvider):
             self.cloud.create_queued_resources(to_create)
         if to_delete:
             self.cloud.delete_queued_resources(to_delete)
-        return self.cloud.list_queued_resources()
+        if to_create or to_delete:
+            listing = self.cloud.list_queued_resources()
+        with self._lock:
+            self._listing = listing
+        return listing
 
     # -------------------------------------------------- provider surface
 
@@ -126,14 +133,17 @@ class TpuPodProvider(NodeProvider):
         self._reconcile()
 
     def node_tags(self, node_id: str) -> Dict[str, str]:
-        listing = self.cloud.list_queued_resources()
-        info = listing.get(node_id, {})
+        with self._lock:
+            listing = self._listing
+        info = listing.get(node_id) or \
+            self.cloud.list_queued_resources().get(node_id, {})
         return {"node-type": info.get("node_type", "?"),
                 "slice": node_id,
                 "state": info.get("state", "?")}
 
     def is_running(self, node_id: str) -> bool:
-        listing = self.cloud.list_queued_resources()
+        with self._lock:
+            listing = dict(self._listing)
         return listing.get(node_id, {}).get("state") == ACTIVE
 
 

@@ -154,13 +154,18 @@ class DashboardHead:
             for spec in body.get("applications", []):
                 mod_name, _, attr = spec["import_path"].partition(":")
                 target = getattr(importlib.import_module(mod_name), attr)
+                overrides = {k: spec[k] for k in
+                             ("num_replicas", "max_ongoing_requests",
+                              "user_config") if k in spec}
                 if isinstance(target, Deployment):
-                    overrides = {k: spec[k] for k in
-                                 ("num_replicas", "max_ongoing_requests",
-                                  "user_config") if k in spec}
                     if overrides:
                         target = target.options(**overrides)
                     target = target.bind(*spec.get("args", ()))
+                elif isinstance(target, Application) and overrides:
+                    # Config overrides apply to bound apps too.
+                    target = Application(
+                        target.deployment.options(**overrides),
+                        target.init_args, target.init_kwargs)
                 if not isinstance(target, Application):
                     raise TypeError(
                         f"{spec['import_path']} is not a Deployment or "

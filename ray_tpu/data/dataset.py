@@ -146,6 +146,77 @@ def _split_rows(rows: List[Any], max_rows: int):
         yield rows[i:i + max_rows]
 
 
+class _ShuffleMerger:
+    """Reduce-side actor of the push-based shuffle: accumulates its
+    partition's parts AS MAP TASKS FINISH (peak memory = one output
+    partition, not the dataset), then emits one block. Parts carry their
+    source-block index so the merged output preserves global row order
+    regardless of map-task completion order."""
+
+    def __init__(self, finish_blob):
+        import cloudpickle
+
+        self._parts: List[Any] = []   # (source_index, rows)
+        self._finish = (cloudpickle.loads(finish_blob)
+                        if finish_blob else None)
+
+    def add(self, order_key: int, part) -> bool:
+        self._parts.append((order_key, part))
+        return True
+
+    def finish(self):
+        self._parts.sort(key=lambda kv: kv[0])
+        rows = [r for _k, part in self._parts for r in part]
+        self._parts = []
+        return self._finish(rows) if self._finish else rows
+
+
+def _push_based_shuffle(block_refs: List[Any], partition_fn,
+                        num_partitions: int,
+                        merge_finish=None) -> List[Any]:
+    """Two pipelined stages (reference:
+    ``python/ray/data/_internal/push_based_shuffle.py``): map tasks split
+    each block into ``num_partitions`` parts; parts stream to per-
+    partition merger actors the moment their map task completes (the
+    "push"), overlapping map and merge with bounded merger memory. The
+    driver only routes object refs — row data never passes through it.
+    """
+    import cloudpickle
+
+    P = num_partitions
+    finish_blob = cloudpickle.dumps(merge_finish) if merge_finish else None
+    merger_cls = ray_tpu.remote(_ShuffleMerger)
+    mergers = [merger_cls.remote(finish_blob)
+               for _ in builtins.range(P)]
+
+    @ray_tpu.remote
+    def map_block(rows, idx):
+        parts = partition_fn(rows, idx)
+        return tuple(parts) if P > 1 else parts[0]
+
+    pending: Dict[Any, tuple] = {}
+    for i, b in enumerate(block_refs):
+        refs = map_block.options(num_returns=P).remote(b, i)
+        refs = refs if isinstance(refs, list) else [refs]
+        pending[refs[0]] = (i, refs)
+    adds = []
+    outstanding = list(pending.keys())
+    while outstanding:
+        ready, outstanding = ray_tpu.wait(outstanding, num_returns=1)
+        idx, refs = pending.pop(ready[0])
+        for p, r in enumerate(refs):
+            adds.append(mergers[p].add.remote(idx, r))
+    ray_tpu.get(adds)           # every part merged
+    out = [m.finish.remote() for m in mergers]
+    ray_tpu.wait(out, num_returns=P)   # blocks exist before mergers die
+    for m in mergers:
+        try:
+            ray_tpu.kill(m)
+        except Exception:
+            pass
+    return out
+
+
 def _resolve_dynamic_blocks(gen_refs: List[Any]) -> List[Any]:
     """Flatten generator refs into per-block refs (one small get per
     generator object; the blocks themselves stay in the store)."""
@@ -292,35 +363,107 @@ class Dataset:
     # ---------------------------------------------------------- all-to-all
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self._all_rows()
+        """Push-based shuffle into ``num_blocks`` even partitions,
+        preserving global row order (a count pass computes each block's
+        offset; rows map to contiguous target ranges)."""
         n = max(1, num_blocks)
-        per = (len(rows) + n - 1) // n if rows else 0
-        parts = [rows[i * per:(i + 1) * per] for i in builtins.range(n)] \
-            if per else [[] for _ in builtins.range(n)]
-        return Dataset([ray_tpu.put(p) for p in parts])
+        blocks = self._execute()
+
+        @ray_tpu.remote
+        def count(rows):
+            return len(rows)
+
+        counts = ray_tpu.get([count.remote(b) for b in blocks])
+        offsets = list(itertools.accumulate([0] + counts))
+        total = offsets[-1]
+        per = (total + n - 1) // n if total else 1
+
+        def partition(rows, idx):
+            start = offsets[idx]
+            parts = [[] for _ in builtins.range(n)]
+            for i, r in enumerate(rows):
+                parts[min((start + i) // per, n - 1)].append(r)
+            return parts
+
+        return Dataset(_push_based_shuffle(blocks, partition, n))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        rows = self._all_rows()
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(len(rows))
-        shuffled = [rows[i] for i in order]
+        """Distributed random shuffle: rows scatter to random partitions
+        (map side), each merger permutes its partition locally — no
+        driver materialization."""
         nb = max(1, len(self._input_blocks))
-        per = (len(shuffled) + nb - 1) // nb if shuffled else 1
-        return Dataset([ray_tpu.put(shuffled[i * per:(i + 1) * per])
-                        for i in builtins.range(nb)])
+        base_seed = seed if seed is not None else 0x5eed
+
+        def partition(rows, idx):
+            # Seeded per source block: deterministic for a given seed
+            # across runs and processes (no str-hash salting).
+            rng = np.random.default_rng((base_seed, idx))
+            parts = [[] for _ in builtins.range(nb)]
+            for r in rows:
+                parts[int(rng.integers(0, nb))].append(r)
+            return parts
+
+        def finish(rows):
+            rng = np.random.default_rng(base_seed + 1)
+            order = rng.permutation(len(rows))
+            return [rows[i] for i in order]
+
+        return Dataset(_push_based_shuffle(self._execute(), partition, nb,
+                                           merge_finish=finish))
 
     def sort(self, key: Optional[Any] = None,
              descending: bool = False) -> "Dataset":
-        rows = self._all_rows()
+        """Distributed sample-based range sort (reference:
+        data/_internal/push_based_shuffle.py + sort.py sample stage):
+        sample keys -> choose P-1 range boundaries -> range-partition on
+        the map side -> each merger sorts locally -> globally ordered
+        block sequence, without the driver ever holding the dataset."""
+        import bisect
+
         if isinstance(key, str):
             keyfn = lambda r: r[key]  # noqa: E731
+        elif key is None:
+            keyfn = lambda r: r       # noqa: E731
         else:
             keyfn = key
-        rows.sort(key=keyfn, reverse=descending)
-        nb = max(1, len(self._input_blocks))
-        per = (len(rows) + nb - 1) // nb if rows else 1
-        return Dataset([ray_tpu.put(rows[i * per:(i + 1) * per])
-                        for i in builtins.range(nb)])
+        blocks = self._execute()
+        nb = max(1, len(blocks))
+
+        @ray_tpu.remote
+        def sample_keys(rows):
+            step = max(1, len(rows) // 20)
+            return sorted(keyfn(r) for r in rows[::step])
+
+        samples = sorted(
+            k for part in ray_tpu.get([sample_keys.remote(b)
+                                       for b in blocks]) for k in part)
+        if samples and nb > 1:
+            bounds = [samples[int(len(samples) * i / nb)]
+                      for i in builtins.range(1, nb)]
+        else:
+            bounds = []
+
+        def partition(rows, idx):
+            parts = [[] for _ in builtins.range(nb)]
+            for r in rows:
+                parts[bisect.bisect_right(bounds, keyfn(r))].append(r)
+            return parts
+
+        def finish(rows):
+            rows.sort(key=keyfn)
+            return rows
+
+        out = _push_based_shuffle(blocks, partition, nb,
+                                  merge_finish=finish)
+        if descending:
+            out = list(reversed(out))
+
+            @ray_tpu.remote
+            def rev(rows):
+                return list(reversed(rows))
+
+            out = [rev.remote(b) for b in out]
+        return Dataset(out)
 
     def zip(self, other: "Dataset") -> "Dataset":
         a, b = self._all_rows(), other._all_rows()

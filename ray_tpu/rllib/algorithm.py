@@ -78,8 +78,11 @@ class AlgorithmConfig:
             # like SAC build their own continuous policy spec from the
             # recorded bounds (one probe env total).
             self.num_actions = int(np.prod(act.shape))
-            self.action_low = float(np.min(act.low))
-            self.action_high = float(np.max(act.high))
+            # Per-dimension bounds (heterogeneous Box spaces rescale and
+            # correct the density per dim, not with one scalar).
+            self.action_low = tuple(np.asarray(act.low).ravel().tolist())
+            self.action_high = tuple(
+                np.asarray(act.high).ravel().tolist())
         close = getattr(probe, "close", None)
         if close:
             close()

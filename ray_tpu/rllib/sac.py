@@ -27,8 +27,9 @@ _LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
 class ContinuousPolicySpec:
     obs_dim: int
     action_dim: int
-    action_low: float = -1.0
-    action_high: float = 1.0
+    # Scalars broadcast; tuples give per-dimension Box bounds.
+    action_low: Any = -1.0
+    action_high: Any = 1.0
     hidden: tuple = (128, 128)
 
 
@@ -43,9 +44,6 @@ class SACConfig(AlgorithmConfig):
     tau: float = 0.005              # polyak factor for target critics
     init_alpha: float = 0.1
     autotune_alpha: bool = True     # entropy temperature learning
-    # Filled from the env at setup when None:
-    action_dim: Optional[int] = None
-    obs_dim: Optional[int] = None
 
 
 class ContinuousReplayBuffer:
@@ -140,12 +138,14 @@ class GaussianPolicy:
                 ).sum(-1)
         logp -= (2 * (np.log(2.0) - pre
                       - jax.nn.softplus(-2 * pre))).sum(-1)
-        scale = (spec.action_high - spec.action_low) / 2.0
-        mid = (spec.action_high + spec.action_low) / 2.0
+        low = np.asarray(spec.action_low, np.float32)
+        high = np.asarray(spec.action_high, np.float32)
+        scale = (high - low) / 2.0        # per-dimension for Box bounds
+        mid = (high + low) / 2.0
         # Affine-rescaling Jacobian: without it the density (and thus the
         # entropy estimate auto-alpha tunes against) is off by
-        # action_dim * log(scale) for non-[-1,1] Box bounds.
-        logp -= spec.action_dim * np.log(scale)
+        # sum(log scale) for non-[-1,1] Box bounds.
+        logp -= float(np.sum(np.log(scale)))
         return a * scale + mid, logp
 
     @classmethod
@@ -335,11 +335,13 @@ class SAC(Algorithm):
         import ray_tpu
 
         config = self.config
-        # Spaces (incl. Box bounds) were probed once by infer_spaces.
+        # Spaces (incl. Box bounds) were probed once by infer_spaces;
+        # config.hidden sizes the actor/critic MLPs.
         self.cspec = ContinuousPolicySpec(
             obs_dim=config.obs_dim, action_dim=config.num_actions,
             action_low=getattr(config, "action_low", -1.0),
-            action_high=getattr(config, "action_high", 1.0))
+            action_high=getattr(config, "action_high", 1.0),
+            hidden=tuple(config.hidden))
         self.learner = SACLearner(self.cspec, config)
         self.buffer = ContinuousReplayBuffer(
             config.buffer_size, self.cspec.obs_dim, self.cspec.action_dim)

@@ -181,3 +181,32 @@ def test_lost_put_object_fails_cleanly(two_node_cluster):
     with pytest.raises(Exception) as ei:
         ray_tpu.get(ref, timeout=20)
     assert "lost" in str(ei.value) or "Lost" in str(ei.value)
+
+
+def test_actor_task_args_pinned_in_flight(fast_free_cluster):
+    """An ObjectRef passed to a BUSY actor and immediately dropped by the
+    caller must survive until the actor executes the task — the custody
+    chain caller->NM->worker pins it past the free-grace window
+    (regression: shuffle parts were freed while adds sat in actor
+    queues)."""
+    import gc
+    import time
+
+    @ray_tpu.remote
+    class Slowpoke:
+        def block(self, sec):
+            time.sleep(sec)
+            return "done"
+
+        def read(self, arr):
+            return int(np.asarray(arr).sum())
+
+    a = Slowpoke.remote()
+    ray_tpu.get(a.block.remote(0.0))   # actor up
+    blocker = a.block.remote(2.0)      # occupy the actor > grace window
+    payload = ray_tpu.put(np.ones(1024, np.int64))
+    res = a.read.remote(payload)
+    del payload                        # caller's last ref dies NOW
+    gc.collect()
+    assert ray_tpu.get(res, timeout=60) == 1024
+    assert ray_tpu.get(blocker, timeout=60) == "done"
