@@ -228,26 +228,67 @@ def _resolve_dynamic_blocks(gen_refs: List[Any]) -> List[Any]:
 
 class Dataset:
     def __init__(self, block_refs: List[Any],
-                 stages: Optional[List[_Stage]] = None):
+                 stages: Optional[List[_Stage]] = None,
+                 logical: Optional[list] = None):
         self._input_blocks = list(block_refs)
         self._stages: List[_Stage] = list(stages or [])
+        # Logical operator chain mirroring the stages (reference:
+        # _internal/logical/ — what explain() and the optimizer rules
+        # operate on; see ray_tpu/data/logical.py).
+        self._logical: list = list(logical or [])
         self._cached: Optional[List[Any]] = None  # executed block refs
 
     # -------------------------------------------------------- construction
 
-    def _with_stage(self, stage: _Stage) -> "Dataset":
-        return Dataset(self._input_blocks, self._stages + [stage])
+    def _with_stage(self, stage: _Stage, lop) -> "Dataset":
+        # Every stage carries its NAMED logical op: rules like limit
+        # pushdown key on names, so an unnamed stage would be unsound.
+        return Dataset(self._input_blocks, self._stages + [stage],
+                       self._logical + [lop])
+
+    def explain(self) -> str:
+        """Render logical -> optimized -> physical plans (reference:
+        Dataset plan introspection over _internal/logical/)."""
+        from ray_tpu.data import logical as logical_mod
+
+        text = logical_mod.explain(self._logical)
+        print(text)
+        return text
 
     # ------------------------------------------------------------ executor
 
+    def _lowered(self):
+        """(stages, early_limit, final_limit) from the optimized logical
+        plan — the single lowering point shared by every executor."""
+        from ray_tpu.data import logical as logical_mod
+
+        if not self._logical:
+            return self._stages, None, None
+        opt = logical_mod.optimize(self._logical)
+        groups, early_limit, final_limit = logical_mod.lower(opt)
+        stages = [_Stage(op.kind, op.fn,
+                         **{k: v for k, v in op.kwargs.items()
+                            if k in ("batch_size", "batch_format")})
+                  for g in groups for op in g]
+        return stages, early_limit, final_limit
+
     def _execute(self) -> List[Any]:
-        """Fuse all pending stages into one task per block (bulk executor)."""
+        """Optimize the logical plan, lower to fused stages, execute one
+        task per block (bulk executor); a pushed-down Limit stops
+        scheduling block tasks once enough rows exist."""
         if self._cached is not None:
             return self._cached
-        if not self._stages:
+        stages, early_limit, final_limit = self._lowered()
+        if early_limit is not None:
+            self._cached = self._execute_with_limit(stages, early_limit)
+            return self._cached
+        if final_limit is not None:
+            refs = self._run_all(stages)
+            self._cached = self._trim_blocks(refs, final_limit)
+            return self._cached
+        if not stages:
             self._cached = self._input_blocks
             return self._cached
-        stages = self._stages
         max_rows = DataContext.get_current().target_max_rows_per_block
 
         if max_rows:
@@ -265,12 +306,71 @@ class Dataset:
                 [_run_block_dyn.remote(b) for b in self._input_blocks])
             return self._cached
 
+        self._cached = self._run_all(stages)
+        return self._cached
+
+    def _run_all(self, stages: List[_Stage]) -> List[Any]:
+        if not stages:
+            return list(self._input_blocks)
+
         @ray_tpu.remote
         def _run_block(rows):
             return _apply_stages(rows, stages)
 
-        self._cached = [_run_block.remote(b) for b in self._input_blocks]
-        return self._cached
+        return [_run_block.remote(b) for b in self._input_blocks]
+
+    def _trim_blocks(self, refs: List[Any], limit: int) -> List[Any]:
+        """Exact global limit over executed blocks (post non-front-limit
+        lowering: blocks were already capped per-block)."""
+        @ray_tpu.remote
+        def _count(rows):
+            return len(rows)
+
+        @ray_tpu.remote
+        def _head(rows, k):
+            return rows[:k]
+
+        counts = ray_tpu.get([_count.remote(r) for r in refs])
+        out: List[Any] = []
+        produced = 0
+        for ref, n in zip(refs, counts):
+            if produced >= limit:
+                break
+            if produced + n > limit:
+                ref = _head.remote(ref, limit - produced)
+                n = limit - produced
+            out.append(ref)
+            produced += n
+        return out
+
+    def _execute_with_limit(self, stages: List[_Stage],
+                            limit: int) -> List[Any]:
+        """Early-stop execution for a FRONT-of-chain Limit: cap the
+        INPUT rows the rest of the chain consumes (a leading limit
+        bounds consumption, whatever filter/flat_map follow), stopping
+        block scheduling once enough input exists. Unscheduled blocks
+        are never read — the win limit pushdown exists for."""
+        @ray_tpu.remote
+        def _count(rows):
+            return len(rows)
+
+        @ray_tpu.remote
+        def _run_block(rows, take):
+            rows = rows[:take] if take is not None else rows
+            return _apply_stages(rows, stages) if stages else rows
+
+        counts = ray_tpu.get([_count.remote(b)
+                              for b in self._input_blocks])
+        out: List[Any] = []
+        consumed = 0
+        for b, n_in in zip(self._input_blocks, counts):
+            if consumed >= limit:
+                break
+            take = min(n_in, limit - consumed)
+            out.append(_run_block.remote(
+                b, take if take < n_in else None))
+            consumed += take
+        return out
 
     def materialize(self) -> "Dataset":
         ds = Dataset(self._execute())
@@ -289,12 +389,18 @@ class Dataset:
         import collections as _collections
 
         prefetch = max(1, int(prefetch_blocks))
-        if self._cached is not None or not self._stages:
+        stages, early_limit, final_limit = self._lowered()
+        if early_limit is not None or final_limit is not None:
+            # Limits need the sequential early-stop/trim executor; its
+            # output blocks then stream.
+            for ref in self._execute():
+                yield ray_tpu.get(ref)
+            return
+        if self._cached is not None or not stages:
             for ref in (self._cached if self._cached is not None
                         else self._input_blocks):
                 yield ray_tpu.get(ref)
             return
-        stages = self._stages
 
         @ray_tpu.remote
         def _run_block(rows):
@@ -318,7 +424,8 @@ class Dataset:
         dataset.streaming_split). Use split() for row-exact splitting."""
         shards = []
         for i in builtins.range(n):
-            shards.append(Dataset(self._input_blocks[i::n], self._stages))
+            shards.append(Dataset(self._input_blocks[i::n], self._stages,
+                                  self._logical))
         return shards
 
     def _all_rows(self) -> List[Any]:
@@ -329,36 +436,55 @@ class Dataset:
 
     # ---------------------------------------------------------- transforms
 
+    def _named(self, name: str, stage: _Stage, **meta) -> "Dataset":
+        from ray_tpu.data.logical import LogicalOp
+
+        return self._with_stage(
+            stage, LogicalOp(name, stage.kind, stage.fn,
+                             {**stage.kwargs, **meta}))
+
     def map(self, fn: Callable) -> "Dataset":
-        return self._with_stage(_Stage("row", lambda r, f=fn: [f(r)]))
+        return self._named("Map", _Stage("row", lambda r, f=fn: [f(r)]))
 
     def flat_map(self, fn: Callable) -> "Dataset":
-        return self._with_stage(_Stage("row", fn))
+        return self._named("FlatMap", _Stage("row", fn))
 
     def filter(self, fn: Callable) -> "Dataset":
-        return self._with_stage(
-            _Stage("row", lambda r, f=fn: [r] if f(r) else []))
+        return self._named("Filter", _Stage(
+            "row", lambda r, f=fn: [r] if f(r) else []))
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy") -> "Dataset":
-        return self._with_stage(_Stage("batch", fn, batch_size=batch_size,
-                                       batch_format=batch_format))
+        return self._named("MapBatches", _Stage(
+            "batch", fn, batch_size=batch_size, batch_format=batch_format))
 
     def add_column(self, name: str, fn: Callable) -> "Dataset":
         def add(row):
             row = dict(row)
             row[name] = fn(row)
             return [row]
-        return self._with_stage(_Stage("row", add))
+        return self._named("AddColumn", _Stage("row", add))
 
     def drop_columns(self, cols: Sequence[str]) -> "Dataset":
-        cols = set(cols)
-        return self.map(lambda r: {k: v for k, v in r.items()
-                                   if k not in cols})
+        colset = set(cols)
+        return self._named("DropColumns", _Stage(
+            "row", lambda r: [{k: v for k, v in r.items()
+                               if k not in colset}]), cols=list(cols))
 
     def select_columns(self, cols: Sequence[str]) -> "Dataset":
         cols = list(cols)
-        return self.map(lambda r: {k: r[k] for k in cols})
+        return self._named("SelectColumns", _Stage(
+            "row", lambda r: [{k: r[k] for k in cols}]), cols=cols)
+
+    def limit(self, k: int) -> "Dataset":
+        """Logical Limit: pushed toward the source past row-preserving
+        operators so execution stops scheduling block tasks early
+        (reference: rules/limit_pushdown.py)."""
+        from ray_tpu.data.logical import LogicalOp
+
+        return Dataset(self._input_blocks, self._stages,
+                       self._logical + [LogicalOp(
+                           "Limit", "limit", None, {"limit": int(k)})])
 
     # ---------------------------------------------------------- all-to-all
 
@@ -507,10 +633,12 @@ class Dataset:
         executes only when iteration reaches it (reference:
         dataset.window -> DatasetPipeline, _internal pipeline executor)."""
         blocks, stages = self._input_blocks, self._stages
+        logical = self._logical
 
         def windows():
             for i in builtins.range(0, len(blocks), blocks_per_window):
-                yield Dataset(blocks[i:i + blocks_per_window], stages)
+                yield Dataset(blocks[i:i + blocks_per_window], stages,
+                              logical)
 
         return DatasetPipeline(windows, length=max(
             1, (len(blocks) + blocks_per_window - 1) // blocks_per_window))

@@ -126,3 +126,71 @@ def test_from_numpy_pandas_arrow(ray_cluster):
     import pyarrow as pa
     t = pa.table({"q": [7, 8]})
     assert rd.from_arrow(t).count() == 2
+
+
+def test_logical_plan_explain_and_rules(ray_cluster):
+    """Logical operator layer (reference: data/_internal/logical/):
+    named operators, projection collapse, limit pushdown, fusion in the
+    rendered physical plan."""
+    ds = (rd.range(100, parallelism=4)
+          .map(lambda x: {"a": x, "b": -x, "c": 2 * x})
+          .select_columns(["a", "b", "c"])
+          .select_columns(["a", "b"])
+          .limit(5))
+    text = ds.explain()
+    assert "Limit[5]" in text and "SelectColumns" in text
+    # Projection collapse: one SelectColumns survives optimization.
+    opt_line = [ln for ln in text.splitlines() if ln.startswith("Optimized")][0]
+    assert opt_line.count("SelectColumns") == 1
+    # Limit pushed in front of the row-preserving chain -> EarlyStop.
+    assert "EarlyStop[5]" in text
+    rows = ds.take_all()
+    assert rows == [{"a": a, "b": -a} for a in range(5)]
+
+    # Filter blocks the push (it shrinks rows): limit must apply to the
+    # FILTERED stream, exactly.
+    ds2 = (rd.range(100, parallelism=4)
+           .map(lambda x: {"a": x, "b": -x})
+           .filter(lambda r: r["a"] % 2 == 0)
+           .limit(5))
+    t2 = ds2.explain()
+    assert "EarlyStop" not in t2 and "GlobalTrim[5]" in t2
+    assert ds2.take_all() == [{"a": a, "b": -a} for a in (0, 2, 4, 6, 8)]
+
+
+def test_limit_pushdown_skips_blocks(ray_cluster):
+    """A pushed-down limit must not execute every block: with 8 blocks
+    and limit(3), at most 2 block tasks run (execution is sequential
+    until the limit fills)."""
+    ds = rd.range(80, parallelism=8).map(lambda x: x + 1).limit(3)
+    blocks = ds._execute()
+    assert len(blocks) <= 2, len(blocks)
+    assert sorted(ds.take_all()) == [1, 2, 3]
+
+
+def test_leading_limit_caps_input_not_output(ray_cluster):
+    """limit() BEFORE other ops bounds what the chain CONSUMES: the
+    filter sees only the first 5 rows (none >= 10 -> empty), and a
+    flat_map after a limit still doubles the capped input."""
+    ds = rd.range(100, parallelism=1).limit(5).filter(lambda x: x >= 10)
+    assert ds.take_all() == []
+    ds2 = rd.range(100, parallelism=2).limit(5).flat_map(
+        lambda x: [x, x])
+    assert sorted(ds2.take_all()) == [0, 0, 1, 1, 2, 2, 3, 3, 4, 4]
+    # Streaming paths honor limits too.
+    ds3 = rd.range(100, parallelism=4).map(lambda x: x).limit(5)
+    assert list(ds3.iter_rows()) == [0, 1, 2, 3, 4]
+    shards = rd.range(40, parallelism=4).limit(6).streaming_split(2)
+    total = sum(len(sh.take_all()) for sh in shards)
+    assert total <= 12   # per-shard limit of 6 over its own blocks
+
+
+def test_limit_blocked_by_flat_map(ray_cluster):
+    """flat_map can EXPAND rows, so a limit after it must NOT push past
+    it (correctness of the pushdown guard)."""
+    ds = (rd.range(10, parallelism=2)
+          .flat_map(lambda x: [x, x])
+          .limit(4))
+    text = ds.explain()
+    assert "EarlyStop" not in text          # stayed behind FlatMap
+    assert len(ds.take_all()) == 4
