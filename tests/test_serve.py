@@ -335,3 +335,43 @@ def test_serve_rest_config_deploy(serve_cluster, tmp_path, monkeypatch):
     from ray_tpu import serve
     h = serve.get_deployment_handle("Greeter")
     assert h.remote("rest").result(timeout=60) == "hi rest"
+
+
+def test_http_ingress_routes_graph_root(serve_cluster):
+    """A deployment-graph root is HTTP-reachable through the proxy at
+    its route_prefix like any deployment (reference: http_proxy routing
+    + deployment graph ingress)."""
+    import json as _json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Upper:
+        def __call__(self, s):
+            return str(s).upper()
+
+    @serve.deployment
+    class Greet:
+        def __init__(self, upper):
+            self.upper = upper
+
+        def __call__(self, payload):
+            # HTTP proxy contract: {"path", "query", "method", "json"}.
+            name = (payload.get("json") or {}).get("name", "world") \
+                if isinstance(payload, dict) else payload
+            return {"greeting": self.upper.remote(
+                f"hi {name}").result(timeout=30)}
+
+    serve.run(Greet.bind(Upper.bind()), route_prefix="/greet")
+    from ray_tpu.serve.api import _controller
+    port = ray_tpu.get(_controller().proxy_port.remote())
+    assert port is not None   # controller's proxy (started by the module)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/greet",
+        data=_json.dumps({"name": "graph"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = _json.loads(r.read())
+    assert out == {"greeting": "HI GRAPH"}
