@@ -1,0 +1,90 @@
+"""Control-plane scale probe (reference envelope: BASELINE.md — 1M
+queued tasks; reference mechanism: scheduling classes make the queue
+O(shapes) per event, cluster_task_manager.h:42).
+
+Measures, on one GCS process:
+- sustained submission rate while queueing N INFEASIBLE tasks (they
+  can never place, so this isolates queue/bookkeeping cost);
+- placement latency of a feasible task submitted BEHIND the N queued
+  ones (shape-bucketed queues make this independent of N);
+- actor creation fan-out: K actors created and pinged.
+
+Prints one JSON line per metric. Run: python benchmarks/scale_bench.py
+[N_tasks] [K_actors].
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    k_actors = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(resources={"impossible": 1})
+        def never():
+            return None
+
+        @ray_tpu.remote
+        def feasible():
+            return 42
+
+        # Warm the feasible path (lease + worker up).
+        assert ray_tpu.get(feasible.remote()) == 42
+
+        t0 = time.perf_counter()
+        queued = [never.remote() for _ in range(n_tasks)]
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "infeasible_queue_submit_per_s",
+            "value": round(n_tasks / dt, 1), "unit": "tasks/s",
+            "n": n_tasks}), flush=True)
+
+        # Placement behind the queue: shape-bucketed scheduling means the
+        # N queued infeasible tasks cost O(1) shapes per event, so this
+        # stays in milliseconds regardless of N.
+        lat = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            assert ray_tpu.get(feasible.remote(), timeout=30) == 42
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        print(json.dumps({
+            "metric": "feasible_latency_behind_queue_ms",
+            "value": round(1000 * lat[len(lat) // 2], 2),
+            "unit": "ms (p50)",
+            "p95_ms": round(1000 * lat[int(len(lat) * 0.95)], 2),
+            "queued_behind": n_tasks}), flush=True)
+
+        del queued  # refcount flush churn happens in the background
+
+        @ray_tpu.remote(num_cpus=0)
+        class Pinger:
+            def ping(self):
+                return 1
+
+        t0 = time.perf_counter()
+        actors = [Pinger.remote() for _ in range(k_actors)]
+        acks = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+        dt = time.perf_counter() - t0
+        assert sum(acks) == k_actors
+        print(json.dumps({
+            "metric": "actor_create_and_ping_per_s",
+            "value": round(k_actors / dt, 2), "unit": "actors/s",
+            "n": k_actors}), flush=True)
+        for a in actors:
+            ray_tpu.kill(a)
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
