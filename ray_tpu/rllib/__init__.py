@@ -25,6 +25,10 @@ from ray_tpu.rllib.a2c import A2C, A2CConfig, A2CLearner  # noqa: F401
 from ray_tpu.rllib.impala import (  # noqa: F401
     IMPALA, IMPALAConfig, IMPALALearner,
 )
+from ray_tpu.rllib.connectors import (  # noqa: F401
+    ClipAction, ClipObs, Connector, ConnectorPipeline, FlattenObs,
+    MeanStdFilter,
+)
 from ray_tpu.rllib.offline import (  # noqa: F401
     BC, BCConfig, BCLearner, JsonReader, JsonWriter,
 )

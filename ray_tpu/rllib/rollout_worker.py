@@ -23,9 +23,14 @@ from ray_tpu.rllib.sample_batch import (
 class RolloutWorker:
     def __init__(self, env_creator: Callable[[], Any], spec: PolicySpec,
                  *, gamma: float = 0.99, lam: float = 0.95,
-                 rollout_fragment_length: int = 200, seed: int = 0):
+                 rollout_fragment_length: int = 200, seed: int = 0,
+                 connectors=None):
         import jax
 
+        # Env<->policy transform pipeline (reference: rllib/connectors/;
+        # see ray_tpu/rllib/connectors.py). Obs connectors run before
+        # every policy call; action connectors before every env.step.
+        self.connectors = connectors
         self.env = env_creator()
         self.policy = MLPPolicy(spec)
         self.gamma = gamma
@@ -46,10 +51,15 @@ class RolloutWorker:
             [], [], [], [], [], []
         for _ in range(self.fragment):
             self._rng, key = jax.random.split(self._rng)
-            obs = np.asarray(self._obs, np.float32)[None]
+            raw_obs = np.asarray(self._obs, np.float32)
+            if self.connectors is not None:
+                raw_obs = self.connectors.transform_obs(raw_obs)
+            obs = raw_obs[None]
             a, logp, v = self._act(params, obs, key)
             a = int(a[0])
-            nxt, r, term, trunc, _ = self.env.step(a)
+            env_a = a if self.connectors is None else \
+                self.connectors.transform_action(a)
+            nxt, r, term, trunc, _ = self.env.step(env_a)
             done = bool(term or trunc)
             r = raw_r = float(r)
             if trunc and not term:
@@ -57,8 +67,10 @@ class RolloutWorker:
                 # cut-off tail with V(s') so surviving to the limit isn't
                 # penalized (reference: postprocessing.py treats truncated
                 # episodes with a final value bootstrap).
-                _, v_next = MLPPolicy.forward(
-                    params, np.asarray(nxt, np.float32)[None])
+                nxt_obs = np.asarray(nxt, np.float32)
+                if self.connectors is not None:
+                    nxt_obs = self.connectors.transform_obs(nxt_obs)
+                _, v_next = MLPPolicy.forward(params, nxt_obs[None])
                 r += self.gamma * float(v_next[0])
             obs_buf.append(obs[0])
             act_buf.append(a)
@@ -77,8 +89,10 @@ class RolloutWorker:
         if done_buf[-1]:
             last_value = 0.0
         else:
-            _, v = MLPPolicy.forward(
-                params, np.asarray(self._obs, np.float32)[None])
+            tail_obs = np.asarray(self._obs, np.float32)
+            if self.connectors is not None:
+                tail_obs = self.connectors.transform_obs(tail_obs)
+            _, v = MLPPolicy.forward(params, tail_obs[None])
             last_value = float(v[0])
         rewards = np.asarray(rew_buf, np.float32)
         values = np.asarray(val_buf, np.float32)
