@@ -167,6 +167,11 @@ _d("lease_report_flush_ms", 100,
    "Batch interval for reporting lease-task completions (object "
    "locations + lineage specs) to the GCS.")
 
+_d("tpu_worker_idle_timeout_s", 300.0,
+   "A chip-bound worker parked between same-shape TPU tasks is retired "
+   "after this idle time (its chips return to the node free list). "
+   "Generous by default: re-spawning pays multi-second XLA client init.")
+
 # --- memory monitor ---------------------------------------------------------
 _d("memory_monitor_refresh_ms", 250,
    "Node memory sampling period; 0 disables the monitor "

@@ -799,7 +799,7 @@ class GcsServer:
         """
         if not self._nodes:
             return
-        stuck = False
+        stuck_demands: List[Dict[str, float]] = []
         for key, _q in self._queued_tasks.buckets():
             while True:
                 spec = self._queued_tasks.pop_head(key)
@@ -813,7 +813,7 @@ class GcsServer:
                                             RESTARTING):
                         if not self._schedule_actor(entry):
                             self._queued_tasks.appendleft(spec)
-                            stuck = True
+                            stuck_demands.append(entry.spec.resources)
                             break  # this actor can't place now
                     continue
                 if spec.task_id.binary() in self._cancelled_tasks:
@@ -832,7 +832,7 @@ class GcsServer:
                     # Head of this shape can't place -> nothing behind it
                     # in the same shape can either; skip the bucket.
                     self._queued_tasks.appendleft(spec)
-                    stuck = True
+                    stuck_demands.append(spec.resources)
                     break
                 self._running_tasks[spec.task_id.binary()] = (spec,
                                                               node.node_id)
@@ -843,28 +843,55 @@ class GcsServer:
                     self._release_for(spec, node.node_id)
                     self._queued_tasks.appendleft(spec)
                     break
-        if stuck:
-            self._maybe_revoke_lease_locked()
+        if stuck_demands:
+            self._maybe_revoke_lease_locked(stuck_demands)
 
-    def _maybe_revoke_lease_locked(self):
+    def _feasible_anywhere_locked(self, demand: Dict[str, float]) -> bool:
+        """Could this demand EVER place on a live node's total resources?
+        Infeasible demand (typo'd custom resource, demand parked for the
+        autoscaler) is kept out of lease fairness entirely — the
+        reference parks such tasks in a separate infeasible queue that
+        blocks nothing (cluster_task_manager.h:42)."""
+        return any(n.alive and n.total.fits(demand)
+                   for n in self._nodes.values())
+
+    @staticmethod
+    def _demand_overlaps(demand: Dict[str, float],
+                         held: Dict[str, float]) -> bool:
+        """Does freeing/withholding ``held`` help ``demand`` at all?
+        (Revoking a CPU lease cannot unstick a TPU-shaped task.)"""
+        return any(held.get(k, 0) > 0 for k, v in demand.items() if v > 0)
+
+    def _maybe_revoke_lease_locked(self, stuck_demands):
         """Classic-queue fairness: when scheduled work cannot place while
         worker leases hold capacity, revoke one lease (rate-limited).
-        The holder's in-flight specs fall back to the scheduled path; a
-        brief oversubscription window (worker finishing its current task
-        after the resources are freed) is accepted, as on the classic
-        force-kill paths."""
+        Only a lease whose held resources actually compete with a stuck
+        (and feasible-on-some-node) demand is revoked; the holder drains
+        it gracefully (lease.py revoke)."""
         if not self._leases:
+            return
+        feasible = [d for d in stuck_demands
+                    if self._feasible_anywhere_locked(d)]
+        if not feasible:
             return
         now = time.time()
         if now - self._last_lease_revoke < 0.2:
             return
+        target = None
+        for lid, lease in self._leases.items():
+            if any(self._demand_overlaps(d, lease["resources"])
+                   for d in feasible):
+                target = lid
+                break
+        if target is None:
+            return
         self._last_lease_revoke = now
-        lid, lease = next(iter(self._leases.items()))
+        lease = self._leases[target]
         conn = self._clients.get(lease["client_id"])
-        self._release_lease_locked(lid)
+        self._release_lease_locked(target)
         if conn is not None:
             try:
-                conn.notify("revoke_lease", {"lease_id": lid})
+                conn.notify("revoke_lease", {"lease_id": target})
             except Exception:
                 pass
 
@@ -898,6 +925,27 @@ class GcsServer:
     # the GCS only brokers leases; leased-task submission/completion
     # flows caller -> worker directly and is reported back in batches.)
 
+    def _queued_blocks_lease_locked(self, resources) -> bool:
+        """True if some queued classic-path shape is (a) feasible on at
+        least one live node's total resources and (b) competing with the
+        requested lease shape for a resource."""
+        for _key, q in self._queued_tasks.buckets():
+            if not q:
+                continue
+            head = q[0]
+            if isinstance(head, _ActorCreationShim):
+                entry = self._actors.get(head.actor_id.binary())
+                if entry is None:
+                    continue
+                demand = entry.spec.resources
+            else:
+                demand = head.resources
+            if not self._demand_overlaps(demand, resources):
+                continue
+            if self._feasible_anywhere_locked(demand):
+                return True
+        return False
+
     def _h_request_worker_lease(self, conn, p, msg_id):
         """Grant (or deny) a worker lease for a scheduling shape.
 
@@ -910,9 +958,12 @@ class GcsServer:
         with self._lock:
             resources = p["resources"]
             # Fairness: while classic-path work (tasks, actor creations)
-            # is queued, leases may not grab more capacity — the classic
-            # queue drains first (see also _maybe_revoke_lease_locked).
-            if len(self._queued_tasks) > 0:
+            # that COMPETES for these resources is queued, leases may not
+            # grab more capacity — the classic queue drains first (see
+            # also _maybe_revoke_lease_locked). Queued work that is
+            # infeasible on every live node, or that needs disjoint
+            # resources, does not block the grant.
+            if self._queued_blocks_lease_locked(resources):
                 conn.reply(msg_id, None)
                 return
             node = self._pick_node(resources, None,

@@ -42,7 +42,8 @@ TPU = "TPU"
 
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "conn", "node_id", "nm_address",
-                 "inflight", "idle_since", "dead", "shape_key", "pending")
+                 "inflight", "idle_since", "dead", "shape_key", "pending",
+                 "draining")
 
     def __init__(self, lease_id, worker_id, conn, node_id, nm_address,
                  shape_key):
@@ -55,6 +56,7 @@ class _Lease:
         self.inflight = 0
         self.idle_since: Optional[float] = time.monotonic()
         self.dead = False
+        self.draining = False   # revoked: finish in-flight batch, then drop
         self.pending: Dict[bytes, Any] = {}   # task_id -> spec, in flight
 
 
@@ -327,10 +329,17 @@ class LeaseManager:
                     drained.append(nxt)
             if lease.inflight == 0 and not drained:
                 lease.idle_since = time.monotonic()
+            drain_done = (lease.draining and lease.inflight == 0
+                          and not lease.pending)
+            if drain_done:
+                lease.draining = False
         for spec in done_specs:
             self._decref_deps(spec)
         if drained:
             self._send(lease, drained)
+        if drain_done:
+            # Revocation drain finished: NOW surrender the worker.
+            self._exec_submit(self._drop_lease, lease)
 
     def _fail_specs(self, lease: _Lease, specs: List[Any]):
         """Transport failure (worker/node death) for in-flight specs.
@@ -466,9 +475,15 @@ class LeaseManager:
                 self._kill_reasons.pop(next(iter(self._kill_reasons)))
 
     def revoke(self, lease_id) -> None:
-        """GCS-initiated revocation (classic-queue fairness): retire the
-        lease; its in-flight specs fall back via the conn-close path."""
+        """GCS-initiated revocation (classic-queue fairness): DRAIN the
+        lease — stop dispatching new specs, let the worker's in-flight
+        batch finish, then return the worker. Revocation is a policy
+        decision, not a failure: it must not double-execute tasks already
+        running on the (healthy) worker, consume retry budget, or
+        materialize crash errors (the reference returns leases on
+        spillback without killing workers, direct_task_transport.h:75)."""
         target = None
+        fallback_specs: List[Any] = []
         with self._lock:
             for st in self._shapes.values():
                 for lease in st.leases:
@@ -477,7 +492,21 @@ class LeaseManager:
                         break
                 if target is not None:
                     break
-        if target is not None:
+            if target is None or target.dead:
+                return
+            target.dead = True        # _pick_lease_locked skips it now
+            target.draining = target.inflight > 0
+            st = self._shapes.get(target.shape_key)
+            # The GCS wants this capacity back for the classic queue:
+            # queued (never-sent) specs go to the scheduled path instead
+            # of waiting on a lease being surrendered.
+            if st is not None and st.queue and st.requesting == 0 \
+                    and not any(not l.dead for l in st.leases):
+                while st.queue:
+                    fallback_specs.append(st.queue.popleft())
+        for spec in fallback_specs:
+            self._fallback(spec)
+        if not target.draining:
             self._exec_submit(self._drop_lease, target)
 
     def cancel(self, task_id: bytes, force: bool = False) -> bool:

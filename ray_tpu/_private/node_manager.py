@@ -61,6 +61,9 @@ class WorkerHandle:
     actor_spec: Optional[ActorCreationSpec] = None
     tpu_chips: List[int] = field(default_factory=list)
     dedicated: bool = False        # not returned to the pool
+    env_key: Optional[tuple] = None  # spawn-time env_extra fingerprint
+    tpu_idle_since: float = 0.0    # parked in the chip-bound idle pool
+    isolated: bool = False         # runtime-env cwd/sys.path: never pooled
     pending_pushes: List[tuple] = field(default_factory=list)
     killed_by_us: bool = False
     no_restart_kill: bool = False
@@ -107,6 +110,12 @@ class NodeManager:
         self._num_cpus = num_cpus
         self._max_pool = max(1, int(num_cpus))
         self._free_tpu_chips: Set[int] = set(range(int(num_tpus)))
+        # Chip-bound workers parked between TPU tasks, keyed by
+        # (chip_count, env_key): a second same-shape TPU task reuses the
+        # worker and skips the multi-second XLA client re-init
+        # (reference: worker_pool.h:156 pools workers by runtime-env
+        # hash; here the "hash" is the chip shape + spawn env).
+        self._tpu_idle: Dict[tuple, List[WorkerHandle]] = {}
         self._shutdown = False
 
         total = dict(resources or {})
@@ -447,7 +456,11 @@ class NodeManager:
         except Exception:
             store = {}
         with self._lock:
-            free_chips = len(self._free_tpu_chips)
+            # Parked chip-bound workers count as free capacity: their
+            # chips are reclaimed (or the worker reused) on demand.
+            free_chips = len(self._free_tpu_chips) + sum(
+                len(w.tpu_chips)
+                for pool in self._tpu_idle.values() for w in pool)
             workers = len(self._workers)
         total_chips = int(self._total_resources.get("TPU", 0))
         return {
@@ -517,14 +530,37 @@ class NodeManager:
             self.shutdown()
 
     def _reap_loop(self):
-        """Detect dead worker processes even if their socket lingers."""
+        """Detect dead worker processes even if their socket lingers;
+        retire chip-bound workers parked past their idle timeout."""
+        tpu_idle_timeout = float(config.tpu_worker_idle_timeout_s)
         while not self._shutdown:
             time.sleep(0.2)
             with self._lock:
                 dead = [w for w in self._workers.values()
                         if w.proc.poll() is not None and w.state != "dead"]
+                now = time.time()
+                expired: List[WorkerHandle] = []
+                for key, pool in list(self._tpu_idle.items()):
+                    keep = []
+                    for w in pool:
+                        if now - w.tpu_idle_since > tpu_idle_timeout:
+                            for c in w.tpu_chips:
+                                self._free_tpu_chips.add(c)
+                            w.tpu_chips = []
+                            expired.append(w)
+                        else:
+                            keep.append(w)
+                    if keep:
+                        self._tpu_idle[key] = keep
+                    else:
+                        self._tpu_idle.pop(key, None)
             for w in dead:
                 self._on_worker_death(w)
+            for w in expired:
+                try:
+                    w.conn.notify("exit")
+                except (protocol.ConnectionClosed, AttributeError):
+                    pass
 
     # ---------------------------------------------------------- worker pool
 
@@ -559,6 +595,11 @@ class NodeManager:
         env["RAY_TPU_STORE_PATH"] = self.store_path
         env["RAY_TPU_NODE_ID"] = self.node_id
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if cwd is not None or extra_pythonpath:
+            # Runtime-env isolation: the worker must NOT later prepend
+            # driver sys.path entries ahead of its pinned working_dir /
+            # py_modules snapshot (worker_main honors this flag).
+            env["RAY_TPU_ISOLATED_ENV"] = "1"
         if tpu_chips:
             # Restrict the worker's XLA client to its assigned chips.
             env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
@@ -582,6 +623,8 @@ class NodeManager:
             )
         handle = WorkerHandle(worker_id=worker_id, proc=proc,
                               dedicated=dedicated, tpu_chips=tpu_chips or [],
+                              env_key=(tuple(sorted(env_extra.items()))
+                                       if env_extra else None),
                               log_paths={"stdout": out_path,
                                          "stderr": err_path},
                               log_offsets={"stdout": 0, "stderr": 0})
@@ -614,6 +657,11 @@ class NodeManager:
             self._workers.pop(w.worker_id, None)
             if w in self._idle:
                 self._idle.remove(w)
+            for key, pool in list(self._tpu_idle.items()):
+                if w in pool:
+                    pool.remove(w)
+                    if not pool:
+                        self._tpu_idle.pop(key, None)
             for chip in w.tpu_chips:
                 self._free_tpu_chips.add(chip)
             tasks = dict(w.current_tasks)
@@ -794,34 +842,37 @@ class NodeManager:
                 daemon=True, name="rtpu-nm-renv").start()
             return
         needs_tpu = spec.resources.get(TPU, 0) > 0
-        with self._lock:
-            if needs_tpu:
-                k = int(spec.resources[TPU])
-                chips = sorted(self._free_tpu_chips)[:k]
-                if len(chips) < k:
-                    # Shouldn't happen (GCS accounts TPU), but be safe.
-                    self._task_queue.append(spec)
-                    return
-                for c in chips:
-                    self._free_tpu_chips.discard(c)
-                w = None
-            else:
-                chips = []
-                w = self._pop_idle_locked()
-            if w is None and not needs_tpu:
-                n = len([x for x in self._workers.values() if not x.dedicated])
-                if n < self._max_pool + 2:
-                    self._spawn_worker()
-                self._task_queue.append(spec)
-                return
         if needs_tpu:
+            k = int(spec.resources[TPU])
             env = dict((spec.runtime_env or {}).get("env_vars", {}))
+            env_key = tuple(sorted(env.items())) if env else None
+            with self._lock:
+                w = self._pop_tpu_idle_locked(k, env_key)
+            if w is not None:
+                # Same-shape reuse: chips are already bound and the XLA
+                # client is warm.
+                self._push_task(w, spec)
+                return
+            chips = self._acquire_chips(k)
+            if chips is None:
+                # Shouldn't happen (GCS accounts TPU), but be safe.
+                with self._lock:
+                    self._task_queue.append(spec)
+                return
             w = self._spawn_worker(dedicated=True, env_extra=env,
                                    tpu_chips=chips)
             with self._lock:
                 w.pending_pushes.append(("run_task", spec))
                 w.current_tasks[spec.task_id.binary()] = spec
             return
+        with self._lock:
+            w = self._pop_idle_locked()
+            if w is None:
+                n = len([x for x in self._workers.values() if not x.dedicated])
+                if n < self._max_pool + 2:
+                    self._spawn_worker()
+                self._task_queue.append(spec)
+                return
         self._push_task(w, spec)
 
     def _materialize_runtime_env(self, runtime_env):
@@ -863,20 +914,18 @@ class NodeManager:
         chips: List[int] = []
         k = int(spec.resources.get(TPU, 0))
         if k > 0:
-            with self._lock:
-                free = sorted(self._free_tpu_chips)[:k]
-                if len(free) < k:
+            chips = self._acquire_chips(k)
+            if chips is None:
+                with self._lock:
                     self._task_queue.append(spec)
-                    return
-                for c in free:
-                    self._free_tpu_chips.discard(c)
-                chips = free
+                return
         env = dict(plugin_env)
         env.update((spec.runtime_env or {}).get("env_vars", {}))
         w = self._spawn_worker(dedicated=True, env_extra=env, cwd=cwd,
                                extra_pythonpath=pypaths,
                                tpu_chips=chips or None)
         with self._lock:
+            w.isolated = True
             w.pending_pushes.append(("run_task", spec))
             w.current_tasks[spec.task_id.binary()] = spec
 
@@ -886,6 +935,84 @@ class NodeManager:
             if w.state == IDLE and w.conn is not None and not w.conn.closed:
                 return w
         return None
+
+    def _pop_tpu_idle_locked(self, k: int,
+                             env_key: Optional[tuple] = None
+                             ) -> Optional[WorkerHandle]:
+        """Reuse a parked chip-bound worker of the same shape (its XLA
+        client is already initialized against exactly these chips)."""
+        pool = self._tpu_idle.get((k, env_key))
+        while pool:
+            w = pool.pop()
+            if not pool:
+                self._tpu_idle.pop((k, env_key), None)
+            if w.state == IDLE and w.conn is not None and not w.conn.closed:
+                return w
+            # Stale (conn dropped while parked, process may hang):
+            # reclaim the bound chips NOW — once out of the pool nothing
+            # else could ever free them — and kill the process; the
+            # reaper's poll() path finishes the bookkeeping.
+            for c in w.tpu_chips:
+                self._free_tpu_chips.add(c)
+            w.tpu_chips = []
+            w.killed_by_us = True
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+        return None
+
+    def _reclaim_pooled_chips_locked(self, needed: int) -> List[WorkerHandle]:
+        """When the free list can't cover ``needed`` chips, evict parked
+        TPU workers (any shape) until it can. Chips move to the free list
+        immediately; the returned victims must be killed by the caller
+        OUTSIDE the lock."""
+        victims: List[WorkerHandle] = []
+        if len(self._free_tpu_chips) >= needed:
+            return victims
+        for key in list(self._tpu_idle.keys()):
+            pool = self._tpu_idle[key]
+            while pool and len(self._free_tpu_chips) < needed:
+                w = pool.pop()
+                w.killed_by_us = True
+                for c in w.tpu_chips:
+                    self._free_tpu_chips.add(c)
+                w.tpu_chips = []   # death handler must not double-add
+                victims.append(w)
+            if not pool:
+                self._tpu_idle.pop(key, None)
+            if len(self._free_tpu_chips) >= needed:
+                break
+        return victims
+
+    def _acquire_chips(self, k: int) -> Optional[List[int]]:
+        """Take ``k`` chips off the free list, evicting parked chip-bound
+        workers if the free list alone can't cover it. Returns the chip
+        ids, or None if the node can't provide ``k`` chips even after
+        reclaiming the whole parked pool. Victim kills happen here,
+        outside the lock."""
+        with self._lock:
+            victims = self._reclaim_pooled_chips_locked(k)
+            chips = sorted(self._free_tpu_chips)[:k]
+            if len(chips) < k:
+                chips = None
+            else:
+                for c in chips:
+                    self._free_tpu_chips.discard(c)
+        for v in victims:
+            try:
+                v.proc.kill()
+            except OSError:
+                pass
+        return chips
+
+    def _maybe_refill_pool_locked(self) -> bool:
+        """Keep the prestarted CPU pool full (reference:
+        worker_pool.h:344 PrestartWorkers): spawn a replacement when a
+        pool worker was converted to an actor or died."""
+        n = len([x for x in self._workers.values()
+                 if not x.dedicated and x.state != "dead"])
+        return n < self._max_pool and not self._shutdown
 
     def _push_task(self, w: WorkerHandle, spec: TaskSpec):
         with self._lock:
@@ -903,14 +1030,37 @@ class NodeManager:
 
     def _dispatch_queued(self):
         while True:
+            dispatch = None
             with self._lock:
-                if not self._task_queue:
-                    return
-                w = self._pop_idle_locked()
-                if w is None:
-                    return
-                spec = self._task_queue.pop(0)
-            self._push_task(w, spec)
+                for i, spec in enumerate(self._task_queue):
+                    if spec.resources.get(TPU, 0) > 0:
+                        # TPU specs re-enter the chip-assignment path;
+                        # dispatch only when chips exist (free or
+                        # reclaimable from the parked pool) so a starved
+                        # TPU spec never lands on a chipless CPU worker.
+                        k = int(spec.resources[TPU])
+                        avail = len(self._free_tpu_chips) + sum(
+                            len(x.tpu_chips)
+                            for pool in self._tpu_idle.values()
+                            for x in pool)
+                        if avail >= k:
+                            self._task_queue.pop(i)
+                            dispatch = ("tpu", spec, None)
+                            break
+                    else:
+                        w = self._pop_idle_locked()
+                        if w is None:
+                            continue
+                        self._task_queue.pop(i)
+                        dispatch = ("cpu", spec, w)
+                        break
+            if dispatch is None:
+                return
+            kind, spec, w = dispatch
+            if kind == "tpu":
+                self._on_lease_task(spec)
+            else:
+                self._push_task(w, spec)
 
     def _on_create_actor(self, spec: ActorCreationSpec,
                          offthread: bool = False):
@@ -939,23 +1089,47 @@ class NodeManager:
                 return
         chips: List[int] = []
         k = int(spec.resources.get(TPU, 0))
-        if k > 0:
+        # Fast path: hand the actor a prestarted pool worker (CPU) or a
+        # parked chip-bound worker (TPU) instead of paying a cold
+        # python+jax spawn (reference: PopWorker serves actor-creation
+        # tasks from the pool, worker_pool.h:340).
+        if cwd is None and not pypaths and not env:
+            refill = False
             with self._lock:
-                free = sorted(self._free_tpu_chips)[:k]
-                if len(free) < k:
-                    # report failure back; GCS will keep it pending
-                    self.gcs.notify("actor_state", {
-                        "actor_id": spec.actor_id.binary(), "state": "DEAD",
-                        "creation_failed": True,
-                        "error": "TPU chips unavailable"})
+                w = self._pop_tpu_idle_locked(k, None) if k > 0 \
+                    else self._pop_idle_locked()
+                if w is not None:
+                    w.dedicated = True
+                    w.state = ACTOR
+                    w.actor_id = spec.actor_id.binary()
+                    w.actor_spec = spec
+                    self._actors[spec.actor_id.binary()] = w
+                    conn = w.conn
+                    refill = k == 0 and self._maybe_refill_pool_locked()
+            if w is not None:
+                try:
+                    conn.notify("create_actor", spec)
+                except protocol.ConnectionClosed:
+                    self._on_worker_death(w)
                     return
-                for c in free:
-                    self._free_tpu_chips.discard(c)
-                chips = free
+                if refill:
+                    self._spawn_worker()
+                return
+        if k > 0:
+            chips = self._acquire_chips(k)
+            if chips is None:
+                # report failure back; GCS will keep it pending
+                self.gcs.notify("actor_state", {
+                    "actor_id": spec.actor_id.binary(), "state": "DEAD",
+                    "creation_failed": True,
+                    "error": "TPU chips unavailable"})
+                return
         w = self._spawn_worker(dedicated=True, env_extra=env,
                                tpu_chips=chips, cwd=cwd,
                                extra_pythonpath=pypaths)
         with self._lock:
+            if cwd is not None or pypaths:
+                w.isolated = True
             w.state = ACTOR
             w.actor_id = spec.actor_id.binary()
             w.actor_spec = spec
@@ -1169,10 +1343,17 @@ class NodeManager:
                                       "direct_address": w.direct_address})
             except protocol.ConnectionClosed:
                 self._release_leased_worker(w)
-        for mtype, payload in pushes:
+        for i, (mtype, payload) in enumerate(pushes):
             try:
                 conn.notify(mtype, payload)
             except protocol.ConnectionClosed:
+                # pending_pushes was already swapped out above, so the
+                # death path can't see these: release the parked-window
+                # node pins of this and every remaining undelivered
+                # run_actor_task here, or they leak until node death.
+                for fm, fp in pushes[i:]:
+                    if fm == "run_actor_task":
+                        self._refcount_delta(fp.arg_deps, -1)
                 self._on_worker_death(w)
                 return
             if mtype == "run_actor_task":
@@ -1256,14 +1437,23 @@ class NodeManager:
                 w.state = IDLE
                 self._idle.append(w)
             if release_worker and w.dedicated and w.actor_id is None:
-                # one-shot TPU worker: retire it
-                for chip in w.tpu_chips:
-                    self._free_tpu_chips.add(chip)
-                w.tpu_chips = []
-                try:
-                    conn.notify("exit")
-                except protocol.ConnectionClosed:
-                    pass
+                if w.tpu_chips and not w.isolated and not self._shutdown:
+                    # Park the chip-bound worker for same-shape reuse:
+                    # the next TPU task of this shape skips the
+                    # multi-second fresh-spawn + XLA client init.
+                    w.state = IDLE
+                    w.tpu_idle_since = time.time()
+                    self._tpu_idle.setdefault(
+                        (len(w.tpu_chips), w.env_key), []).append(w)
+                else:
+                    # one-shot dedicated worker (runtime_env): retire it
+                    for chip in w.tpu_chips:
+                        self._free_tpu_chips.add(chip)
+                    w.tpu_chips = []
+                    try:
+                        conn.notify("exit")
+                    except protocol.ConnectionClosed:
+                        pass
         self._report_task_done(p["task_id"], p["status"], p.get("objects"),
                                error=p.get("error"))
         self._dispatch_queued()

@@ -160,6 +160,11 @@ class ActorCreationSpec:
     placement_group_bundle_index: int = -1
     runtime_env: Optional[dict] = None
     class_name: str = ""
+    # Driver's sys.path dirs at creation time: a prestarted pool worker
+    # (spawned before the driver extended its path) prepends missing
+    # entries so by-reference class pickles resolve (reference:
+    # runtime_env working_dir ships driver code; same-host equivalent).
+    sys_path: Optional[List[str]] = None
 
 
 @dataclass

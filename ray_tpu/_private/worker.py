@@ -19,6 +19,7 @@ import atexit
 import concurrent.futures
 import hashlib
 import os
+import sys
 import threading
 import time
 import uuid
@@ -994,6 +995,7 @@ class CoreWorker:
             placement_group_bundle_index=placement_group_bundle_index,
             runtime_env=runtime_env,
             class_name=class_name,
+            sys_path=[p for p in sys.path if p and os.path.isdir(p)],
         )
         self.gcs.request("create_actor", spec)
         with self._actor_lock:

@@ -401,6 +401,16 @@ class WorkerExecutor:
         self.actor_spec = spec
         self._current_task_id = None
         try:
+            # A prestarted pool worker may predate driver sys.path
+            # additions (e.g. a module dir created just before the actor
+            # class was defined): prepend what the driver had so
+            # by-reference pickles resolve. Isolated workers skip this —
+            # driver-local dirs must never shadow their pinned
+            # working_dir / py_modules snapshot.
+            if not os.environ.get("RAY_TPU_ISOLATED_ENV"):
+                for p in reversed(spec.sys_path or []):
+                    if p not in sys.path:
+                        sys.path.insert(0, p)
             cls = self.core.fetch_function(spec.class_key)
             args, kwargs = self.core.deserialize_args(spec.args)
             self.core.ctx.job_id = spec.job_id

@@ -417,11 +417,22 @@ class Dataset:
                 in_flight.append(_run_block.remote(nxt))
             yield ray_tpu.get(ref)
 
+    def _has_limit(self) -> bool:
+        return any(getattr(op, "name", None) == "Limit"
+                   for op in self._logical)
+
     def streaming_split(self, n: int) -> List["Dataset"]:
         """Split by round-robin over INPUT blocks without executing
         anything: each shard keeps the stage chain lazy, so data-parallel
         consumers stream their own blocks (reference:
-        dataset.streaming_split). Use split() for row-exact splitting."""
+        dataset.streaming_split). Use split() for row-exact splitting.
+
+        A Limit in the plan is GLOBAL (reference semantics): the limited
+        dataset executes first and its output blocks are what get
+        sharded — propagating the Limit per shard would return up to n*k
+        rows."""
+        if self._has_limit():
+            return Dataset(self._execute()).streaming_split(n)
         shards = []
         for i in builtins.range(n):
             shards.append(Dataset(self._input_blocks[i::n], self._stages,
@@ -631,7 +642,13 @@ class Dataset:
     def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
         """Split into a pipeline of windows of input blocks; each window
         executes only when iteration reaches it (reference:
-        dataset.window -> DatasetPipeline, _internal pipeline executor)."""
+        dataset.window -> DatasetPipeline, _internal pipeline executor).
+
+        A Limit in the plan is applied globally first (see
+        streaming_split) — windows of an already-limited dataset."""
+        if self._has_limit():
+            return Dataset(self._execute()).window(
+                blocks_per_window=blocks_per_window)
         blocks, stages = self._input_blocks, self._stages
         logical = self._logical
 

@@ -54,6 +54,11 @@ class DeploymentResponse:
 
 
 class DeploymentHandle:
+    # Consecutive listen/lookup failures before the listener thread gives
+    # up (controller gone: serve.shutdown, deployment deleted). A handle
+    # still in use relaunches the listener lazily from _pick().
+    _LISTEN_MAX_FAILURES = 5
+
     def __init__(self, deployment_name: str, method_name: str = "__call__"):
         self.deployment_name = deployment_name
         self._method = method_name
@@ -62,17 +67,24 @@ class DeploymentHandle:
         self._lock = threading.Lock()
         self._rr = random.Random()
         self._listener_started = False
+        self._stopped = False
 
     def __reduce__(self):
         # Handles travel into replicas (deployment graphs); the listener
         # thread restarts lazily on the other side.
         return (DeploymentHandle, (self.deployment_name, self._method))
 
+    def stop(self):
+        """Stop the push listener (the thread exits at its next wakeup)."""
+        with self._lock:
+            self._stopped = True
+
     def _ensure_listener(self):
         with self._lock:
             if self._listener_started:
                 return
             self._listener_started = True
+            self._stopped = False
         threading.Thread(target=self._listen_loop, daemon=True,
                          name=f"serve-longpoll-{self.deployment_name}"
                          ).start()
@@ -83,20 +95,35 @@ class DeploymentHandle:
 
         key = f"replicas:{self.deployment_name}"
         version = 0
+        failures = 0
         while True:
+            with self._lock:
+                if self._stopped:
+                    break
             try:
                 ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
                 updates = ray_tpu.get(
                     ctrl.listen_for_change.remote({key: version}, 25.0),
                     timeout=35)
             except Exception:
+                # Controller unreachable (shutdown, deleted deployment's
+                # cluster going away, transient outage): bounded retries,
+                # then exit instead of leaking a thread that polls
+                # forever. Unpickled handle copies inside dead replicas
+                # die with this too.
+                failures += 1
+                if failures >= self._LISTEN_MAX_FAILURES:
+                    break
                 time.sleep(1.0)
                 continue
+            failures = 0
             if key in updates:
                 version, replicas = updates[key]
                 with self._lock:
                     self._replicas = list(replicas)
                     self._fetched_at = time.time()
+        with self._lock:
+            self._listener_started = False
 
     def options(self, method_name: str) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, method_name)

@@ -180,9 +180,14 @@ def test_leading_limit_caps_input_not_output(ray_cluster):
     # Streaming paths honor limits too.
     ds3 = rd.range(100, parallelism=4).map(lambda x: x).limit(5)
     assert list(ds3.iter_rows()) == [0, 1, 2, 3, 4]
+    # Limit is GLOBAL across streaming_split shards (reference
+    # semantics): 2 shards of limit(6) return 6 rows total, not 12.
     shards = rd.range(40, parallelism=4).limit(6).streaming_split(2)
     total = sum(len(sh.take_all()) for sh in shards)
-    assert total <= 12   # per-shard limit of 6 over its own blocks
+    assert total == 6, total
+    # ...and across pipeline windows.
+    pipe = rd.range(40, parallelism=4).limit(6).window(blocks_per_window=2)
+    assert sum(1 for _ in pipe.iter_rows()) == 6
 
 
 def test_limit_blocked_by_flat_map(ray_cluster):

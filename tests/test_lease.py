@@ -225,6 +225,74 @@ def test_lease_fairness_actor_not_starved(lease_cluster):
     assert ray_tpu.get(stream, timeout=120) == list(range(120))
 
 
+def test_lease_revoke_drains_without_double_execution(lease_cluster, tmp_path):
+    """Fairness revocation is a policy decision, not a failure: tasks
+    already in flight on the (healthy) revoked worker run EXACTLY once,
+    a max_retries=0 task sees no spurious WorkerCrashedError, and the
+    worker is surrendered only after its batch drains."""
+    marker = tmp_path / "runs.txt"
+
+    @ray_tpu.remote(max_retries=0)
+    def side_effect(path, sec):
+        import time as _t
+        with open(path, "a") as f:
+            f.write("ran\n")
+        _t.sleep(sec)
+        return "done"
+
+    # Warm the lease, then put a slow side-effecting task in flight.
+    assert ray_tpu.get(side_effect.remote(str(marker), 0.0)) == "done"
+    ref = side_effect.remote(str(marker), 2.0)
+    lm = _lease_mgr()
+    key = (("CPU", 1.0),)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        st = lm._shapes.get(key)
+        if st and any(l.pending for l in st.leases):
+            break
+        time.sleep(0.05)
+    st = lm._shapes.get(key)
+    lease = next(l for l in st.leases if l.pending)
+    lm.revoke(lease.lease_id)
+    # No WorkerCrashedError, no re-execution.
+    assert ray_tpu.get(ref, timeout=30) == "done"
+    assert marker.read_text().count("ran") == 2  # warm-up + the one task
+    # The drained lease is eventually dropped (worker surrendered).
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if lease not in (lm._shapes.get(key).leases
+                         if lm._shapes.get(key) else []):
+            break
+        time.sleep(0.1)
+    st = lm._shapes.get(key)
+    assert st is None or lease not in st.leases
+
+
+def test_infeasible_queued_task_does_not_block_leases(lease_cluster):
+    """A permanently-unplaceable queued task (typo'd resource) must not
+    deny lease grants or thrash healthy leases: CPU tasks keep the
+    direct transport (reference keeps infeasible tasks in a separate
+    non-blocking queue)."""
+    @ray_tpu.remote(resources={"no_such_resource": 1})
+    def never():
+        return None
+
+    _parked = never.remote()   # queues in the GCS forever  # noqa: F841
+
+    @ray_tpu.remote
+    def pid():
+        import os
+        return os.getpid()
+
+    time.sleep(0.5)   # let the infeasible spec reach the GCS queue
+    pids = {ray_tpu.get(pid.remote()) for _ in range(10)}
+    assert len(pids) == 1, pids   # direct transport engaged + stable
+    lm = _lease_mgr()
+    key = (("CPU", 1.0),)
+    st = lm._shapes.get(key)
+    assert st is not None and any(not l.dead for l in st.leases)
+
+
 def test_lease_fast_result_not_stuck_behind_slow(lease_cluster):
     """A fast task's result must reach the caller promptly even when a
     long task runs right behind it on the same leased worker (results
