@@ -1,0 +1,71 @@
+"""Lease-on vs lease-off microbenchmark comparison.
+
+Runs benchmarks/microbench.py in child processes with the direct task
+transport enabled/disabled (RAY_TPU_LEASE_ENABLED), best of N runs per
+mode, and writes the artifact consumed by the round review
+(MICROBENCH_r{N}.json shape). Run:
+
+    python benchmarks/microbench_compare.py [rounds] [out.json]
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def run_once(lease_enabled: bool) -> dict:
+    env = dict(os.environ)
+    env["RAY_TPU_LEASE_ENABLED"] = "1" if lease_enabled else "0"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("PALLAS_AXON_POOL_IPS", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "microbench.py")],
+        capture_output=True, text=True, timeout=900, env=env)
+    out = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            out[rec["metric"]] = rec["value"]
+    if not out:
+        raise RuntimeError(f"microbench produced no metrics: "
+                           f"{proc.stderr[-500:]}")
+    return out
+
+
+def main():
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    out_path = sys.argv[2] if len(sys.argv) > 2 else None
+    # INTERLEAVED runs (on,off,on,off,...): box-load drift between the
+    # two modes' measurement windows otherwise shows up as a phantom
+    # lease regression on paths that never touch the lease manager.
+    on: dict = {}
+    off: dict = {}
+    for _ in range(rounds):
+        for best, enabled in ((on, True), (off, False)):
+            run = run_once(enabled)
+            for k, v in run.items():
+                best[k] = max(best.get(k, 0.0), v)
+    speedup = {k: round(on[k] / off[k], 2) for k in on if off.get(k)}
+    result = {
+        "description": f"control-plane microbenchmarks, best of {rounds}; "
+                       f"direct task transport (worker leases) on vs off",
+        "lease_on": on,
+        "lease_off": off,
+        "speedup": speedup,
+    }
+    text = json.dumps(result, indent=2)
+    print(text)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -82,6 +82,89 @@ def main():
             "n": k_actors}), flush=True)
         for a in actors:
             ray_tpu.kill(a)
+
+        # Multi-driver concurrency: D separate driver processes hammer
+        # the SAME GCS with task waves (the reference's many-client
+        # regime; SCALE_r04 only ever measured one driver). Reports
+        # aggregate throughput and the worst per-driver p95.
+        import subprocess
+        import tempfile
+
+        from ray_tpu._private import worker as worker_mod
+
+        address = worker_mod.global_worker().gcs_address
+        n_drivers, per_driver = 3, 600
+        child_src = f"""
+import json, sys, time
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import ray_tpu
+ray_tpu.init(address={address!r})
+@ray_tpu.remote
+def nop():
+    return None
+ray_tpu.get(nop.remote())   # warm a lease
+lat = []
+t0 = time.perf_counter()
+refs = [nop.remote() for _ in range({per_driver})]
+ray_tpu.get(refs, timeout=300)
+dt = time.perf_counter() - t0
+for _ in range(20):
+    t1 = time.perf_counter()
+    ray_tpu.get(nop.remote(), timeout=60)
+    lat.append(time.perf_counter() - t1)
+lat.sort()
+print(json.dumps({{"rate": {per_driver} / dt,
+                   "p95_ms": 1000 * lat[int(len(lat) * 0.95)]}}))
+ray_tpu.shutdown()
+"""
+        with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                         delete=False) as f:
+            f.write(child_src)
+            child_path = f.name
+        t0 = time.perf_counter()
+        procs = []
+        outs = []
+        try:
+            procs = [subprocess.Popen([sys.executable, child_path],
+                                      stdout=subprocess.PIPE, text=True)
+                     for _ in range(n_drivers)]
+            for p in procs:
+                try:
+                    outs.append(p.communicate(timeout=600)[0])
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    outs.append("")
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            try:
+                os.unlink(child_path)
+            except OSError:
+                pass
+        wall = time.perf_counter() - t0
+        stats = []
+        for o in outs:
+            lines = (o or "").strip().splitlines()
+            if not lines:
+                continue
+            try:
+                stats.append(json.loads(lines[-1]))
+            except json.JSONDecodeError:
+                pass
+        if stats:
+            print(json.dumps({
+                "metric": "multi_driver_task_throughput_per_s",
+                "value": round(sum(s["rate"] for s in stats), 1),
+                "unit": "tasks/s (aggregate)",
+                "drivers": len(stats), "per_driver": per_driver,
+                "worst_p95_ms": round(max(s["p95_ms"] for s in stats), 2),
+                "wall_s": round(wall, 1)}), flush=True)
+        else:
+            print(json.dumps({
+                "metric": "multi_driver_task_throughput_per_s",
+                "value": 0.0, "unit": "tasks/s (aggregate)",
+                "error": "all child drivers failed"}), flush=True)
     finally:
         ray_tpu.shutdown()
 

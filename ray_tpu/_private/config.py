@@ -167,6 +167,11 @@ _d("lease_report_flush_ms", 100,
    "Batch interval for reporting lease-task completions (object "
    "locations + lineage specs) to the GCS.")
 
+_d("worker_zygote_enabled", True,
+   "Fork CPU workers from a pre-imported zygote process instead of a "
+   "fresh python interpreter per spawn (~10x cheaper under actor "
+   "bursts). TPU workers always use the classic spawn path (PJRT "
+   "plugin registration happens at interpreter start).")
 _d("tpu_worker_idle_timeout_s", 300.0,
    "A chip-bound worker parked between same-shape TPU tasks is retired "
    "after this idle time (its chips return to the node free list). "

@@ -697,6 +697,20 @@ class GcsServer:
             self._enqueue_task(spec)
             self._try_schedule()
 
+    def _h_submit_tasks(self, conn, specs: List[TaskSpec], msg_id):
+        """Batched submission (the lease manager's fallback wave): one
+        lock acquisition + one scheduling pass per batch, so a 100k-task
+        burst drains in hundreds of handler invocations instead of 100k
+        — the probe RPC queued behind it waits milliseconds, not
+        seconds."""
+        with self._lock:
+            for spec in specs:
+                spec.retries_left = spec.max_retries
+                self._retain_spec_locked(spec)
+                self._pin_task_args(spec)
+                self._enqueue_task(spec)
+            self._try_schedule()
+
     def _enqueue_task(self, spec: TaskSpec):
         unready = self._unready_deps(spec.arg_deps)
         if unready:

@@ -92,6 +92,7 @@ class LeaseManager:
         self._idle_timeout = float(config.lease_idle_timeout_s)
         self._flush_s = max(0.01, config.lease_report_flush_ms / 1000.0)
         self._worker_timeout = float(config.worker_start_timeout_s) + 10.0
+        self._bulk_conn = None   # lazy second GCS conn for fallback waves
         self._closed = False
         # Lease acquisition dials node managers / workers (blocking), so it
         # runs here — never on a conn's serve thread.
@@ -287,8 +288,7 @@ class LeaseManager:
                     not l.dead for l in st.leases):
                 while st.queue:
                     specs.append(st.queue.popleft())
-        for spec in specs:
-            self._fallback(spec)
+        self._fallback_many(specs)
 
     def _fallback(self, spec):
         try:
@@ -296,6 +296,38 @@ class LeaseManager:
         except Exception:
             pass   # driver is dying; its refs error out with it
         self._decref_deps(spec)
+
+    _FALLBACK_CHUNK = 500
+
+    def _bulk_conn_get(self):
+        """Dedicated GCS connection for bulk fallback waves: the GCS
+        serves each conn on its own thread, so the driver's synchronous
+        RPCs (on the main channel) interleave between chunks instead of
+        queueing behind a 100k-spec wave (single-conn FIFO would be
+        head-of-line blocking measured in seconds)."""
+        conn = self._bulk_conn
+        if conn is None or conn.closed:
+            conn = self._bulk_conn = protocol.connect(
+                self._w.gcs_address, name="lease-bulk")
+        return conn
+
+    def _fallback_many(self, specs: List[Any]):
+        """Wave fallback (capacity denial, lease drop): batched submits
+        so a big queued burst costs the GCS one handler invocation per
+        chunk, not per spec."""
+        for i in range(0, len(specs), self._FALLBACK_CHUNK):
+            chunk = specs[i:i + self._FALLBACK_CHUNK]
+            try:
+                self._bulk_conn_get().notify("submit_tasks", list(chunk))
+            except Exception:
+                # Bulk conn unavailable: the main (reconnecting) channel
+                # still delivers; a dying driver's refs error out anyway.
+                try:
+                    self._w.gcs.notify("submit_tasks", list(chunk))
+                except Exception:
+                    pass
+            for s in chunk:
+                self._decref_deps(s)
 
     # ------------------------------------------------------- completion
 
@@ -457,8 +489,7 @@ class LeaseManager:
             self._w.gcs.notify("return_lease", {"lease_id": lease.lease_id})
         except Exception:
             pass
-        for spec in requeued:
-            self._fallback(spec)
+        self._fallback_many(requeued)
 
     # ---------------------------------------------------------- get glue
 
@@ -504,8 +535,7 @@ class LeaseManager:
                     and not any(not l.dead for l in st.leases):
                 while st.queue:
                     fallback_specs.append(st.queue.popleft())
-        for spec in fallback_specs:
-            self._fallback(spec)
+        self._fallback_many(fallback_specs)
         if not target.draining:
             self._exec_submit(self._drop_lease, target)
 
@@ -648,6 +678,10 @@ class LeaseManager:
                                    {"lease_id": lease.lease_id})
             except Exception:
                 pass
-        for spec in queued:
-            self._fallback(spec)
+        self._fallback_many(queued)
+        if self._bulk_conn is not None:
+            try:
+                self._bulk_conn.close()
+            except Exception:
+                pass
         self._exec.shutdown(wait=False)

@@ -19,9 +19,11 @@ TPU-first deltas vs the reference raylet:
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -48,6 +50,50 @@ BUSY = "busy"
 STARTING = "starting"
 ACTOR = "actor"
 LEASED = "leased"   # checked out to a caller's direct task transport
+
+
+class _ForkedProc:
+    """``subprocess.Popen``-compatible shim for zygote-forked workers.
+    The zygote is the parent: its SIGCHLD reaper writes an exit-marker
+    file per dead child, which makes poll() authoritative (a bare
+    kill(pid, 0) is fooled by PID reuse / other-user PIDs)."""
+
+    def __init__(self, pid: int, exit_dir: str):
+        self.pid = pid
+        self._exit_marker = os.path.join(exit_dir, str(pid))
+        self._rc: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._rc is None:
+            if os.path.exists(self._exit_marker):
+                self._rc = -1
+            else:
+                try:
+                    os.kill(self.pid, 0)
+                except ProcessLookupError:
+                    self._rc = -1
+                except PermissionError:
+                    # PID recycled to another user's process: ours is
+                    # gone (the marker race window is one reaper tick).
+                    self._rc = -1
+        return self._rc
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    terminate = kill
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.time() > deadline:
+                raise subprocess.TimeoutExpired("forked-worker",
+                                                timeout or 0)
+            time.sleep(0.02)
+        return self._rc  # type: ignore[return-value]
 
 
 @dataclass
@@ -175,6 +221,17 @@ class NodeManager:
             self.store.on_full = lambda needed: bool(
                 self._spill_bytes(int(needed) * 2))
 
+        # Worker fork-server: CPU workers fork from a pre-imported
+        # zygote instead of paying interpreter start per spawn (see
+        # worker_zygote.py; reference analog: prestart amortization,
+        # worker_pool.h:344 — this removes the cost rather than hiding
+        # it).
+        self._zygote: Optional[subprocess.Popen] = None
+        self._zygote_lock = threading.Lock()
+        self._zygote_io = None       # (socket, file) when connected
+        self._zygote_sock_path = ""
+        self._start_zygote()
+
         # Prestart the pool (reference: worker_pool.h:245 PrestartWorkers).
         for _ in range(self._max_pool):
             self._spawn_worker()
@@ -219,11 +276,24 @@ class NodeManager:
                 w.proc.wait(timeout=5)
             except Exception:
                 pass
-        # The spiller touches the store; let it observe _shutdown before
+        if self._zygote is not None:
+            try:
+                self._zygote.kill()
+            except Exception:
+                pass
+            try:
+                os.unlink(self._zygote_sock_path)
+            except OSError:
+                pass
+        # The spiller and heartbeater touch the store (stats() reads the
+        # mmap'd arena through ctypes); let them observe _shutdown before
         # the store handle goes away (segfault otherwise).
         spiller = getattr(self, "_spiller", None)
         if spiller is not None:
             spiller.join(timeout=2)
+        heartbeater = getattr(self, "_heartbeater", None)
+        if heartbeater is not None:
+            heartbeater.join(timeout=2)
         self.server.close()
         try:
             self.gcs.close()
@@ -451,10 +521,15 @@ class NodeManager:
             mem_avail = info.get("MemAvailable")
         except Exception:
             pass
-        try:
-            store = self.store.stats()
-        except Exception:
+        if self._shutdown:
+            # stats() reads the mmap'd arena via ctypes: touching it
+            # while shutdown unmaps is a segfault, not an exception.
             store = {}
+        else:
+            try:
+                store = self.store.stats()
+            except Exception:
+                store = {}
         with self._lock:
             # Parked chip-bound workers count as free capacity: their
             # chips are reclaimed (or the worker reused) on demand.
@@ -564,6 +639,60 @@ class NodeManager:
 
     # ---------------------------------------------------------- worker pool
 
+    def _start_zygote(self) -> None:
+        if not config.worker_zygote_enabled:
+            return
+        env = dict(os.environ)
+        # CPU-only stack in the zygote: no TPU plugin registration
+        # (chip-bound workers keep the classic spawn path), no stale
+        # per-worker identity.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        for k in [k for k in env if k.startswith("RAY_TPU_")]:
+            env.pop(k, None)
+        self._zygote_sock_path = os.path.join(
+            self.session_dir, f"zyg_{self.node_id[:12]}.sock")
+        env["RAY_TPU_ZYGOTE_SOCKET"] = self._zygote_sock_path
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log = os.path.join(log_dir, f"zygote-{self.node_id[:12]}.log")
+        try:
+            with open(log, "ab") as f:
+                self._zygote = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "ray_tpu._private.worker_zygote"],
+                    env=env, stdout=f, stderr=f)
+        except OSError:
+            self._zygote = None
+
+    def _zygote_fork(self, req: dict) -> Optional[_ForkedProc]:
+        """Ask the zygote for a forked worker; None falls back to the
+        classic spawn (zygote still starting, or dead)."""
+        if self._zygote is None or self._zygote.poll() is not None:
+            return None
+        with self._zygote_lock:
+            try:
+                if self._zygote_io is None:
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.settimeout(10.0)
+                    s.connect(self._zygote_sock_path)
+                    self._zygote_io = (s, s.makefile("rwb"))
+                _, f = self._zygote_io
+                f.write((json.dumps(req) + "\n").encode())
+                f.flush()
+                line = f.readline()
+                if not line:
+                    raise OSError("zygote connection closed")
+                return _ForkedProc(int(json.loads(line)["pid"]),
+                                   self._zygote_sock_path + ".exits")
+            except (OSError, ValueError, KeyError):
+                io, self._zygote_io = self._zygote_io, None
+                if io is not None:
+                    try:
+                        io[0].close()
+                    except OSError:
+                        pass
+                return None
+
     def _spawn_worker(self, dedicated: bool = False,
                       env_extra: Optional[Dict[str, str]] = None,
                       tpu_chips: Optional[List[int]] = None,
@@ -613,14 +742,31 @@ class NodeManager:
         wid12 = worker_id.hex()[:12]
         out_path = os.path.join(log_dir, f"worker-{wid12}.out")
         err_path = os.path.join(log_dir, f"worker-{wid12}.err")
-        with open(out_path, "ab") as f_out, open(err_path, "ab") as f_err:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_main"],
-                env=env,
-                cwd=cwd or os.getcwd(),
-                stdout=f_out,
-                stderr=f_err,
-            )
+        proc = None
+        if not tpu_chips and cwd is None and not extra_pythonpath \
+                and not env_extra:
+            # Plain CPU worker: fork from the pre-imported zygote
+            # (interpreter start + imports already paid). Worker vars
+            # only — the zygote holds the base environment.
+            proc = self._zygote_fork({
+                "env": {k: env[k] for k in (
+                    "RAY_TPU_WORKER_ID", "RAY_TPU_NM_ADDRESS",
+                    "RAY_TPU_GCS_ADDRESS", "RAY_TPU_STORE_PATH",
+                    "RAY_TPU_NODE_ID", "RAY_TPU_SESSION_DIR")},
+                "stdout": out_path, "stderr": err_path,
+                "cwd": None,
+                "sys_path": [p for p in roots if p],
+            })
+        if proc is None:
+            with open(out_path, "ab") as f_out, \
+                    open(err_path, "ab") as f_err:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                    env=env,
+                    cwd=cwd or os.getcwd(),
+                    stdout=f_out,
+                    stderr=f_err,
+                )
         handle = WorkerHandle(worker_id=worker_id, proc=proc,
                               dedicated=dedicated, tpu_chips=tpu_chips or [],
                               env_key=(tuple(sorted(env_extra.items()))

@@ -219,6 +219,99 @@ def needs_isolation(runtime_env: Optional[Dict[str, Any]]) -> bool:
                for p in _sorted_plugins() if p.name in runtime_env)
 
 
+class PipPlugin(RuntimeEnvPlugin):
+    """``runtime_env={"pip": [...]}``: per-env virtualenv, content-cached
+    by the hash of the requirement list (reference:
+    ``python/ray/_private/runtime_env/pip.py`` — venv per pip spec,
+    ``uri_cache.py`` content addressing).
+
+    The venv is created with ``--system-site-packages`` (the cluster's
+    baked-in jax/numpy stack stays visible) and its site-packages is
+    PREPENDED to the worker's import path, so env-pinned versions shadow
+    system ones. Workers with different pip envs are separate processes
+    (``needs_isolation``), so two tasks can import different versions of
+    the same package concurrently.
+
+    Value forms: ``["pkg==1.0", ...]`` or
+    ``{"packages": [...], "pip_install_options": [...]}``.
+    """
+
+    name = "pip"
+    priority = 5   # before plugins that may import from the env
+
+    def package(self, value: Any, kv) -> Any:
+        if isinstance(value, (list, tuple)):
+            value = {"packages": list(value)}
+        if not isinstance(value, dict) or not isinstance(
+                value.get("packages", []), list):
+            raise ValueError(f"runtime_env['pip'] must be a list of "
+                             f"requirements or a dict, got {value!r}")
+        return {"packages": [str(p) for p in value.get("packages", [])],
+                "pip_install_options":
+                    [str(o) for o in value.get("pip_install_options", [])]}
+
+    def create(self, value: Any, context: Dict[str, Any],
+               base_dir: str) -> None:
+        import fcntl
+        import json
+        import subprocess
+        import sys as _sys
+
+        if isinstance(value, (list, tuple)):
+            value = {"packages": list(value)}
+        packages = value.get("packages", [])
+        options = value.get("pip_install_options", [])
+        spec = json.dumps({"packages": packages, "options": options},
+                          sort_keys=True)
+        h = hashlib.sha1(spec.encode()).hexdigest()
+        root = os.path.join(base_dir, "pip")
+        os.makedirs(root, exist_ok=True)
+        venv_dir = os.path.join(root, h)
+        ready = os.path.join(venv_dir, ".ready")
+        # Cross-process lock: concurrent tasks wanting the same env build
+        # it once (reference: uri_cache single-flight).
+        with open(os.path.join(root, h + ".lock"), "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            if not os.path.exists(ready):
+                import venv as venv_mod
+
+                venv_mod.create(venv_dir, system_site_packages=True,
+                                with_pip=False, symlinks=True)
+                if packages:
+                    # Install with the CURRENT interpreter targeting the
+                    # venv's site-packages: avoids needing pip bootstrapped
+                    # inside the venv and works offline for local wheels.
+                    proc = subprocess.run(
+                        [_sys.executable, "-m", "pip", "install", "-q",
+                         "--target", self._site_packages(venv_dir),
+                         *options, *packages],
+                        capture_output=True, text=True, timeout=600)
+                    if proc.returncode != 0:
+                        import shutil
+
+                        shutil.rmtree(venv_dir, ignore_errors=True)
+                        raise RuntimeError(
+                            f"pip install failed (rc={proc.returncode}): "
+                            f"{(proc.stderr or '')[-800:]}")
+                with open(ready, "w") as f:
+                    f.write(spec)
+        context["env_vars"]["VIRTUAL_ENV"] = venv_dir
+        # Prepend: env-pinned versions shadow system site-packages.
+        context["py_paths"].insert(0, self._site_packages(venv_dir))
+
+    @staticmethod
+    def _site_packages(venv_dir: str) -> str:
+        import sys as _sys
+
+        return os.path.join(
+            venv_dir, "lib",
+            f"python{_sys.version_info[0]}.{_sys.version_info[1]}",
+            "site-packages")
+
+
+register_plugin(PipPlugin())
+
+
 def ensure_runtime_env(kv_get, runtime_env: Optional[Dict[str, Any]],
                        base_dir: str
                        ) -> Tuple[Optional[str], List[str],

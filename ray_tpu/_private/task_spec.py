@@ -125,6 +125,9 @@ class TaskSpec:
     placement_group_bundle_index: int = -1
     runtime_env: Optional[dict] = None
     submitted_at: float = field(default_factory=time.time)
+    # {trace_id, parent_span_id}: carried across hops so task events form
+    # a distributed trace (reference: tracing_helper.py:284 _ray_trace_ctx).
+    trace_ctx: Optional[Dict[str, Any]] = None
 
     def return_ids(self) -> List[ObjectID]:
         if self.num_returns == "dynamic":
@@ -165,6 +168,7 @@ class ActorCreationSpec:
     # entries so by-reference class pickles resolve (reference:
     # runtime_env working_dir ships driver code; same-host equivalent).
     sys_path: Optional[List[str]] = None
+    trace_ctx: Optional[Dict[str, Any]] = None   # see TaskSpec.trace_ctx
 
 
 @dataclass
@@ -182,6 +186,7 @@ class ActorTaskSpec:
     seqno: int = 0
     concurrency_group: str = ""
     retries_left: int = 0
+    trace_ctx: Optional[Dict[str, Any]] = None   # see TaskSpec.trace_ctx
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_return(self.task_id, i)

@@ -160,6 +160,12 @@ class _ObjArg:
         self.id_bytes = id_bytes
 
 
+def _tracing():
+    from ray_tpu.util import tracing
+
+    return tracing
+
+
 class _RefTracker:
     """Batches local ObjectRef incref/decref deltas to the GCS (the
     owner-table half of reference_count.h:61, aggregated centrally)."""
@@ -188,6 +194,12 @@ class _RefTracker:
         while not self._stop.wait(self._interval):
             self.flush()
 
+    # Deltas per notify: bounds how long one GCS handler invocation
+    # holds the global lock. Unchunked, a 100k-task submission burst
+    # flushed as ONE message stalls scheduling for seconds (SCALE_r04:
+    # p95 placement 6.3 s behind a 100k queue).
+    _FLUSH_CHUNK = 2000
+
     def flush(self):
         with self._lock:
             deltas = dict(self._pending)
@@ -197,11 +209,14 @@ class _RefTracker:
         # dropped within one flush window still becomes free-eligible.
         if not deltas:
             return
-        try:
-            self._worker.gcs.notify("update_refcounts", {
-                "client_id": self._worker.client_id, "deltas": deltas})
-        except Exception:
-            pass  # disconnecting; the GCS drops our counts anyway
+        items = list(deltas.items())
+        for i in range(0, len(items), self._FLUSH_CHUNK):
+            try:
+                self._worker.gcs.notify("update_refcounts", {
+                    "client_id": self._worker.client_id,
+                    "deltas": dict(items[i:i + self._FLUSH_CHUNK])})
+            except Exception:
+                return  # disconnecting; the GCS drops our counts anyway
 
     def stop(self):
         self._stop.set()
@@ -932,6 +947,7 @@ class CoreWorker:
                                 if placement_group is not None else None),
             placement_group_bundle_index=placement_group_bundle_index,
             runtime_env=runtime_env,
+            trace_ctx=_tracing().for_submit(),
         )
         # Direct transport first: plain tasks stream to a leased worker
         # (submit() declines when closed/over capacity -> scheduled path).
@@ -996,6 +1012,7 @@ class CoreWorker:
             runtime_env=runtime_env,
             class_name=class_name,
             sys_path=[p for p in sys.path if p and os.path.isdir(p)],
+            trace_ctx=_tracing().for_submit(),
         )
         self.gcs.request("create_actor", spec)
         with self._actor_lock:
@@ -1042,6 +1059,7 @@ class CoreWorker:
             caller_id=self.client_id,
             seqno=seq,
             concurrency_group=concurrency_group,
+            trace_ctx=_tracing().for_submit(),
         )
         self._dispatch_actor_task(spec)
         return [ObjectRef(oid) for oid in spec.return_ids()]

@@ -314,6 +314,8 @@ class WorkerExecutor:
             os._exit(0)
 
     def _set_ctx(self, spec, actor_id: Optional[ActorID] = None):
+        from ray_tpu.util import tracing
+
         ctx = self.core.ctx
         ctx.task_id = spec.task_id
         ctx.job_id = spec.job_id
@@ -322,6 +324,11 @@ class WorkerExecutor:
                                 getattr(spec, "method_name", ""))
         ctx.put_index = 0
         self.core.job_id = spec.job_id
+        # Continue the caller's trace: tasks submitted from THIS task
+        # become its children (reference: tracing_helper.py:318 context
+        # re-attachment on the execution side).
+        tracing.activate(getattr(spec, "trace_ctx", None),
+                         spec.task_id.binary().hex())
 
     def _execute_task(self, spec: TaskSpec):
         self._current_task_id = spec.task_id.binary()
@@ -411,6 +418,11 @@ class WorkerExecutor:
                 for p in reversed(spec.sys_path or []):
                     if p not in sys.path:
                         sys.path.insert(0, p)
+            from ray_tpu.util import tracing
+
+            tracing.activate(
+                getattr(spec, "trace_ctx", None),
+                TaskID.for_actor_creation(spec.actor_id).binary().hex())
             cls = self.core.fetch_function(spec.class_key)
             args, kwargs = self.core.deserialize_args(spec.args)
             self.core.ctx.job_id = spec.job_id
@@ -592,6 +604,9 @@ class WorkerExecutor:
         """Buffer the event; a flusher ships batches to the GCS (one
         notify per flush window, not per task — at 1k+ tasks/s per worker
         a per-task notify measurably loads the single GCS lock)."""
+        from ray_tpu.util import tracing
+
+        trace = tracing.current() or {}
         with self._event_lock:
             self._event_buf.append({
                 "task_id": task_id.hex(),
@@ -603,6 +618,9 @@ class WorkerExecutor:
                 "start": start,
                 "end": time.time(),
                 "status": status,
+                "trace_id": trace.get("trace_id"),
+                "span_id": trace.get("span_id"),
+                "parent_span_id": trace.get("parent_span_id"),
             })
 
     def _event_flush_loop(self):

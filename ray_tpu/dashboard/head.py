@@ -11,26 +11,79 @@ from typing import Optional
 
 _INDEX_HTML = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
-<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
-td,th{border:1px solid #999;padding:4px 8px}</style></head>
+<style>
+body{font-family:ui-monospace,monospace;margin:1.5em;color:#222}
+table{border-collapse:collapse;margin-bottom:1em}
+td,th{border:1px solid #bbb;padding:3px 8px;font-size:13px}
+th{background:#f2f2f2;text-align:left}
+h3{margin:0.8em 0 0.3em}
+.dead{color:#b00}.ok{color:#080}
+nav a{margin-right:1em}
+.bar{display:inline-block;height:10px;background:#4a8;vertical-align:middle}
+.barbg{display:inline-block;width:80px;height:10px;background:#ddd}
+small{color:#666}
+</style></head>
 <body><h2>ray_tpu cluster</h2>
+<nav><small>auto-refresh 2s — JSON under /api/{nodes,actors,tasks,objects,
+jobs,placement_groups,summary}, Prometheus at /metrics</small></nav>
 <div id=out>loading…</div>
 <script>
+const esc = s => String(s ?? '').replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',
+         "'":'&#39;'}[c]));
+const short = s => esc((s || '').slice(0, 12));
+const pct = (used, total) => total ? Math.round(100 * used / total) : 0;
+function bar(p){return `<span class=barbg><span class=bar style="width:${
+  Math.min(80, Math.round(0.8 * p))}px"></span></span> ${p}%`}
+async function j(u){try{return await fetch(u).then(r=>r.json())}
+                    catch(e){return []}}
 async function refresh(){
-  const [nodes, jobs, summary] = await Promise.all([
-    fetch('/api/nodes').then(r=>r.json()),
-    fetch('/api/jobs').then(r=>r.json()),
-    fetch('/api/summary').then(r=>r.json())]);
-  let h = '<h3>nodes</h3><table><tr><th>id</th><th>alive</th>' +
-          '<th>resources</th><th>available</th></tr>';
-  for (const n of nodes) h += `<tr><td>${n.NodeID.slice(0,12)}</td>` +
-      `<td>${n.Alive}</td><td>${JSON.stringify(n.Resources)}</td>` +
-      `<td>${JSON.stringify(n.Available)}</td></tr>`;
-  h += '</table><h3>jobs</h3><table><tr><th>id</th><th>state</th></tr>';
-  for (const j of jobs) h += `<tr><td>${j.job_id}</td>` +
-      `<td>${j.state}</td></tr>`;
+  const [nodes, jobs, summary, actors, pgs, serve] = await Promise.all([
+    j('/api/nodes'), j('/api/jobs'), j('/api/summary'), j('/api/actors'),
+    j('/api/placement_groups'), j('/api/serve/applications')]);
+  let h = '<h3>nodes</h3><table><tr><th>id</th><th>state</th>' +
+      '<th>cpu</th><th>mem</th><th>tpu chips</th><th>store</th>' +
+      '<th>workers</th><th>labels</th></tr>';
+  for (const n of nodes){
+    const hw = n.Hardware || {};
+    const chips = hw.tpu_chips_total ?
+      `${hw.tpu_chips_free}/${hw.tpu_chips_total} free` : '—';
+    const mem = hw.mem_total_bytes ?
+      bar(pct(hw.mem_total_bytes - hw.mem_available_bytes,
+              hw.mem_total_bytes)) : '—';
+    const store = hw.store_capacity_bytes ?
+      bar(pct(hw.store_used_bytes, hw.store_capacity_bytes)) : '—';
+    h += `<tr><td>${short(n.NodeID)}${n.IsHead ? ' (head)' : ''}</td>` +
+      `<td class=${n.Alive ? 'ok' : 'dead'}>${
+        n.Alive ? 'ALIVE' : 'DEAD'}</td>` +
+      `<td>${hw.cpu_percent != null ? bar(Math.round(hw.cpu_percent))
+            : '—'}</td>` +
+      `<td>${mem}</td><td>${chips}</td><td>${store}</td>` +
+      `<td>${esc(hw.workers ?? '—')}</td>` +
+      `<td>${esc(JSON.stringify(n.Labels))}</td></tr>`;
+  }
+  h += '</table><h3>actors</h3><table><tr><th>id</th><th>class</th>' +
+       '<th>state</th><th>node</th><th>restarts</th></tr>';
+  for (const a of actors.slice(0, 50))
+    h += `<tr><td>${short(a.actor_id)}</td>` +
+      `<td>${esc(a.class_name || '')}</td>` +
+      `<td>${esc(a.state)}</td><td>${short(a.node_id)}</td>` +
+      `<td>${esc(a.num_restarts ?? 0)}</td></tr>`;
+  if (actors.length > 50)
+    h += `<tr><td colspan=5>… ${actors.length - 50} more</td></tr>`;
+  h += '</table><h3>placement groups</h3><table><tr><th>name</th>' +
+       '<th>state</th><th>strategy</th><th>bundles</th></tr>';
+  for (const g of pgs)
+    h += `<tr><td>${esc(g.name || '')}</td><td>${esc(g.state)}</td>` +
+      `<td>${esc(g.strategy)}</td>` +
+      `<td>${esc(JSON.stringify(g.bundles))}</td></tr>`;
+  h += '</table><h3>serve</h3><pre>' +
+       esc(JSON.stringify(serve, null, 1)) + '</pre>';
+  h += '<h3>jobs</h3><table><tr><th>id</th><th>state</th></tr>';
+  for (const jb of jobs)
+    h += `<tr><td>${esc(jb.job_id)}</td><td>${esc(jb.state)}</td></tr>`;
   h += '</table><h3>task summary</h3><pre>' +
-       JSON.stringify(summary, null, 2) + '</pre>';
+       esc(JSON.stringify(summary, null, 1)) + '</pre>';
   document.getElementById('out').innerHTML = h;
 }
 refresh(); setInterval(refresh, 2000);

@@ -10,6 +10,7 @@ training step (mesh shardings), not the dataset.
 """
 
 from ray_tpu.data.dataset import (  # noqa: F401
+    ActorPoolStrategy,
     DataContext,
     Dataset,
     DatasetPipeline,
@@ -29,7 +30,8 @@ from ray_tpu.data.dataset import (  # noqa: F401
 range = range_  # noqa: A001
 
 __all__ = [
-    "Dataset", "DatasetPipeline", "from_items", "range", "from_numpy", "from_pandas",
+    "ActorPoolStrategy", "DataContext", "Dataset", "DatasetPipeline",
+    "from_items", "range", "from_numpy", "from_pandas",
     "from_arrow", "read_text", "read_csv", "read_json", "read_parquet",
     "read_binary_files",
 ]

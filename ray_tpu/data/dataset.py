@@ -114,7 +114,48 @@ def _apply_stages(rows: List[Any], stages: List[_Stage]) -> List[Any]:
     return rows
 
 
+@ray_tpu.remote
+class _MapBatchesActor:
+    """Pool worker for ``map_batches(compute=ActorPoolStrategy(...))``:
+    the UDF (a class) is constructed ONCE here — model loading, chip
+    warmup — and then maps every block routed to this actor (reference:
+    data/_internal/compute.py ActorPoolStrategy + _BlockWorker)."""
+
+    def __init__(self, fn, ctor_args, ctor_kwargs):
+        if isinstance(fn, type):
+            self._fn = fn(*(ctor_args or ()), **(ctor_kwargs or {}))
+        else:
+            self._fn = fn
+
+    def run_block(self, rows, batch_size, batch_format):
+        return _Stage("batch", self._fn, batch_size=batch_size,
+                      batch_format=batch_format).apply(rows)
+
+
 # --------------------------------------------------------------- dataset
+
+
+class ActorPoolStrategy:
+    """Compute strategy for ``map_batches``: run the UDF on a pool of
+    long-lived actors instead of one task per block (reference:
+    ``python/ray/data/_internal/compute.py`` ActorPoolStrategy). The
+    pattern exists for stateful / expensive-init UDFs — load a JAX model
+    once per actor, stream blocks through it (TPU batch inference).
+
+    The pool starts at ``min_size`` actors and autoscales up to
+    ``max_size`` while blocks are backlogged (every actor at its
+    in-flight cap)."""
+
+    def __init__(self, min_size: int = 1, max_size: Optional[int] = None):
+        if min_size < 1:
+            raise ValueError("min_size must be >= 1")
+        if max_size is not None and max_size < min_size:
+            raise ValueError("max_size must be >= min_size")
+        self.min_size = int(min_size)
+        self.max_size = int(max_size) if max_size is not None else None
+
+    def __repr__(self):
+        return f"ActorPoolStrategy(min={self.min_size}, max={self.max_size})"
 
 
 class DataContext:
@@ -257,19 +298,46 @@ class Dataset:
 
     # ------------------------------------------------------------ executor
 
-    def _lowered(self):
-        """(stages, early_limit, final_limit) from the optimized logical
-        plan — the single lowering point shared by every executor."""
+    def _lowered_segments(self):
+        """(segments, early_limit, final_limit) from the optimized
+        logical plan. Each segment is ("tasks", [stage...]) — one fused
+        task per block — or ("actors", stage) — an actor-pool
+        map_batches stage (fusion barrier)."""
         from ray_tpu.data import logical as logical_mod
 
         if not self._logical:
-            return self._stages, None, None
+            segs = [("tasks", self._stages)] if self._stages else []
+            return segs, None, None
         opt = logical_mod.optimize(self._logical)
         groups, early_limit, final_limit = logical_mod.lower(opt)
-        stages = [_Stage(op.kind, op.fn,
-                         **{k: v for k, v in op.kwargs.items()
-                            if k in ("batch_size", "batch_format")})
-                  for g in groups for op in g]
+        segments = []
+        for g in groups:
+            if g[0].kind == "actor_batch":
+                op = g[0]
+                segments.append(("actors", _Stage(
+                    "actor_batch", op.fn, **op.kwargs)))
+            else:
+                segments.append(("tasks", [
+                    _Stage(op.kind, op.fn,
+                           **{k: v for k, v in op.kwargs.items()
+                              if k in ("batch_size", "batch_format")})
+                    for op in g]))
+        return segments, early_limit, final_limit
+
+    def _has_actor_compute(self) -> bool:
+        return any(getattr(op, "kind", None) == "actor_batch"
+                   for op in self._logical)
+
+    def _lowered(self):
+        """(flat stages, early_limit, final_limit) for the task-only
+        executors. Callers must route actor-compute plans through
+        _execute_segments first (_has_actor_compute)."""
+        segments, early_limit, final_limit = self._lowered_segments()
+        stages: List[_Stage] = []
+        for tag, payload in segments:
+            assert tag == "tasks", \
+                "actor-compute plan reached a task-only executor"
+            stages.extend(payload)
         return stages, early_limit, final_limit
 
     def _execute(self) -> List[Any]:
@@ -277,6 +345,9 @@ class Dataset:
         task per block (bulk executor); a pushed-down Limit stops
         scheduling block tasks once enough rows exist."""
         if self._cached is not None:
+            return self._cached
+        if self._has_actor_compute():
+            self._cached = self._execute_segments()
             return self._cached
         stages, early_limit, final_limit = self._lowered()
         if early_limit is not None:
@@ -308,6 +379,78 @@ class Dataset:
 
         self._cached = self._run_all(stages)
         return self._cached
+
+    def _execute_segments(self) -> List[Any]:
+        """Executor for plans with actor-pool stages: task segments run
+        one fused task per block; actor segments stream blocks through a
+        stateful pool (reference: _internal/compute.py — the planner
+        chooses TaskPoolStrategy or ActorPoolStrategy per op)."""
+        segments, early_limit, final_limit = self._lowered_segments()
+        blocks = list(self._input_blocks)
+        if early_limit is not None:
+            # Front-of-chain limit caps what the chain CONSUMES.
+            blocks = self._trim_blocks(blocks, early_limit)
+        for tag, payload in segments:
+            if tag == "actors":
+                blocks = list(self._actor_pool_map(blocks, payload))
+            elif payload:
+                blocks = Dataset(blocks)._run_all(payload)
+        if final_limit is not None and early_limit is None:
+            blocks = self._trim_blocks(blocks, final_limit)
+        return blocks
+
+    @staticmethod
+    def _actor_pool_map(block_refs: List[Any], stage: _Stage,
+                        inflight_per_actor: int = 2) -> Iterator[Any]:
+        """Map blocks through an autoscaling actor pool, preserving
+        block order. Yields each block's result ref as its dispatch is
+        admitted (bounded in-flight = streaming backpressure). The pool
+        grows one actor at a time while every actor is at its in-flight
+        cap and blocks are waiting, up to the strategy's max_size."""
+        comp = stage.kwargs.get("compute") or ActorPoolStrategy()
+        max_size = comp.max_size or max(comp.min_size, 4)
+        ctor = (stage.fn, stage.kwargs.get("fn_constructor_args") or (),
+                stage.kwargs.get("fn_constructor_kwargs") or {})
+        bs = stage.kwargs.get("batch_size")
+        bf = stage.kwargs.get("batch_format", "numpy")
+        actors = [_MapBatchesActor.remote(*ctor)
+                  for _ in builtins.range(comp.min_size)]
+        pending: Dict[Any, int] = {}   # result ref -> actor index
+        results: List[Any] = []
+        try:
+            for b in block_refs:
+                while True:
+                    loads = [0] * len(actors)
+                    for idx in pending.values():
+                        loads[idx] += 1
+                    idx = min(builtins.range(len(actors)),
+                              key=lambda i: loads[i])
+                    if loads[idx] < inflight_per_actor:
+                        break
+                    if len(actors) < max_size:
+                        # Backlogged: grow the pool within bounds.
+                        actors.append(_MapBatchesActor.remote(*ctor))
+                        idx = len(actors) - 1
+                        break
+                    # At capacity: wait for one completion.
+                    ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+                    for r in ready:
+                        pending.pop(r, None)
+                ref = actors[idx].run_block.remote(b, bs, bf)
+                pending[ref] = idx
+                results.append(ref)
+            # Results live in the node object store, so the pool can be
+            # torn down once every block has been produced.
+            if results:
+                ray_tpu.wait(results, num_returns=len(results),
+                             timeout=None)
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        return results
 
     def _run_all(self, stages: List[_Stage]) -> List[Any]:
         if not stages:
@@ -389,6 +532,14 @@ class Dataset:
         import collections as _collections
 
         prefetch = max(1, int(prefetch_blocks))
+        if self._has_actor_compute():
+            # Actor-pool plans: the pool itself streams with bounded
+            # in-flight (see _actor_pool_map); iterate its output blocks
+            # (_execute serves from the cache when already materialized —
+            # _lowered() below is task-only and would assert).
+            for ref in self._execute():
+                yield ray_tpu.get(ref)
+            return
         stages, early_limit, final_limit = self._lowered()
         if early_limit is not None or final_limit is not None:
             # Limits need the sequential early-stop/trim executor; its
@@ -465,9 +616,32 @@ class Dataset:
             "row", lambda r, f=fn: [r] if f(r) else []))
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
-                    batch_format: str = "numpy") -> "Dataset":
+                    batch_format: str = "numpy",
+                    compute: Optional[Any] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None
+                    ) -> "Dataset":
+        """Map over batches. With ``compute="actors"`` (or an
+        ``ActorPoolStrategy``), ``fn`` may be a CLASS: one instance is
+        constructed per pool actor (expensive init — e.g. loading a JAX
+        model onto a chip — runs once per actor, not once per block) and
+        its ``__call__`` maps each batch (reference:
+        data/_internal/compute.py ActorPoolStrategy)."""
+        if compute is None:
+            return self._named("MapBatches", _Stage(
+                "batch", fn, batch_size=batch_size,
+                batch_format=batch_format))
+        if compute == "actors":
+            compute = ActorPoolStrategy()
+        if not isinstance(compute, ActorPoolStrategy):
+            raise ValueError(
+                f"compute must be None, 'actors', or an ActorPoolStrategy; "
+                f"got {compute!r}")
         return self._named("MapBatches", _Stage(
-            "batch", fn, batch_size=batch_size, batch_format=batch_format))
+            "actor_batch", fn, batch_size=batch_size,
+            batch_format=batch_format, compute=compute,
+            fn_constructor_args=tuple(fn_constructor_args),
+            fn_constructor_kwargs=dict(fn_constructor_kwargs or {})))
 
     def add_column(self, name: str, fn: Callable) -> "Dataset":
         def add(row):

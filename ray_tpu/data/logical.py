@@ -150,14 +150,20 @@ def lower(ops: List[LogicalOp]):
             op = LogicalOp("LimitLocal", "block",
                            (lambda kk: lambda rows: rows[:kk])(k),
                            {"limit": k})
-        if op.kind in FUSABLE:
+        if op.kind == "actor_batch":
+            # Actor-pool stage (compute="actors"): a fusion BARRIER — it
+            # runs on a stateful actor pool, never inside a block task
+            # (reference: _internal/compute.py ActorPoolStrategy).
+            groups.append([op])
+            groups.append([])   # ops after it fuse into a fresh group
+        elif op.kind in FUSABLE:
             if groups:
                 groups[-1].append(op)
             else:
                 groups.append([op])
         else:
             raise ValueError(f"cannot lower op kind {op.kind!r}")
-    return groups, early_limit, final_limit
+    return [g for g in groups if g], early_limit, final_limit
 
 
 def explain(ops: List[LogicalOp]) -> str:
@@ -170,8 +176,14 @@ def explain(ops: List[LogicalOp]) -> str:
     if early_limit is not None:
         phys.append(f"EarlyStop[{early_limit}]")
     for g in groups:
-        phys.append("FusedTaskPerBlock(" +
-                    "+".join(op.describe() for op in g) + ")")
+        if g[0].kind == "actor_batch":
+            comp = g[0].kwargs.get("compute")
+            phys.append(f"ActorPool({g[0].describe()}, "
+                        f"min={getattr(comp, 'min_size', 1)}, "
+                        f"max={getattr(comp, 'max_size', None)})")
+        else:
+            phys.append("FusedTaskPerBlock(" +
+                        "+".join(op.describe() for op in g) + ")")
     if final_limit is not None and early_limit is None:
         phys.append(f"GlobalTrim[{final_limit}]")
     return (f"Logical:   {raw}\n"

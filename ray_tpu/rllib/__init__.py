@@ -21,6 +21,8 @@ from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.learner_group import LearnerGroup  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner  # noqa: F401
+from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig  # noqa: F401
+from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig  # noqa: F401
 from ray_tpu.rllib.a2c import A2C, A2CConfig, A2CLearner  # noqa: F401
 from ray_tpu.rllib.impala import (  # noqa: F401
     IMPALA, IMPALAConfig, IMPALALearner,
@@ -47,6 +49,8 @@ __all__ = [
     "SampleBatch", "concat_batches", "MLPPolicy", "PolicySpec",
     "RolloutWorker", "Algorithm", "AlgorithmConfig", "LearnerGroup",
     "PPO", "PPOConfig", "PPOLearner",
+    "DDPPO", "DDPPOConfig",
+    "ApexDQN", "ApexDQNConfig",
     "A2C", "A2CConfig", "A2CLearner",
     "IMPALA", "IMPALAConfig", "IMPALALearner",
     "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",

@@ -1,0 +1,309 @@
+"""Ape-X DQN: distributed prioritized replay (reference:
+``rllib/algorithms/apex_dqn/apex_dqn.py`` — sharded replay-buffer actors,
+rollout workers pushing experience WITHOUT a driver hop, a learner that
+continuously samples/trains/updates priorities, periodic weight refresh;
+prioritized buffer per
+``rllib/utils/replay_buffers/prioritized_replay_buffer.py``).
+
+TPU-first split: env stepping and experience storage stay on CPU actors;
+the learner's double-DQN TD update is one jitted XLA program per
+minibatch (chip-residency for the hot loop). Sampling and learning
+overlap — rollout tasks stay in flight across training_step calls and
+are relaunched with fresh weights as they complete.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.dqn import DQNConfig, DQNLearner, _DQNRolloutWorker
+from ray_tpu.rllib.policy import PolicySpec
+
+
+@dataclasses.dataclass
+class ApexDQNConfig(DQNConfig):
+    num_replay_shards: int = 2
+    prioritized_replay_alpha: float = 0.6
+    prioritized_replay_beta: float = 0.4
+    prioritized_replay_eps: float = 1e-6
+
+
+class _ReplayShard:
+    """One prioritized replay shard (actor). Sampling probability is
+    p_i^alpha / sum p^alpha; importance weights (N * P(i))^-beta are
+    returned normalized by their max (reference:
+    prioritized_replay_buffer.py)."""
+
+    def __init__(self, capacity: int, obs_dim: int, alpha: float,
+                 eps: float, seed: int):
+        self.capacity = capacity
+        self.alpha = alpha
+        self.eps = eps
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity,), np.int32)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.float32)
+        self.prios = np.zeros((capacity,), np.float64)
+        self._next = 0
+        self.size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add_batch(self, batch: Dict[str, Any],
+                  priorities: Optional[np.ndarray] = None) -> int:
+        n = len(batch["actions"])
+        if priorities is None:
+            # New experience gets max priority: every transition is
+            # replayed at least ~once before priorities take over.
+            mx = float(self.prios[:self.size].max()) if self.size else 1.0
+            priorities = np.full(n, mx)
+        for i in range(n):
+            j = self._next
+            self.obs[j] = batch["obs"][i]
+            self.actions[j] = batch["actions"][i]
+            self.rewards[j] = batch["rewards"][i]
+            self.next_obs[j] = batch["next_obs"][i]
+            self.dones[j] = batch["dones"][i]
+            self.prios[j] = max(float(priorities[i]), self.eps)
+            self._next = (self._next + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+        return self.size
+
+    def sample(self, n: int, beta: float):
+        if self.size == 0:
+            return None
+        n = min(n, self.size)
+        p = self.prios[:self.size] ** self.alpha
+        p = p / p.sum()
+        idx = self._rng.choice(self.size, size=n, p=p)
+        w = (self.size * p[idx]) ** (-beta)
+        w = (w / w.max()).astype(np.float32)
+        return ({"obs": self.obs[idx], "actions": self.actions[idx],
+                 "rewards": self.rewards[idx],
+                 "next_obs": self.next_obs[idx],
+                 "dones": self.dones[idx], "weights": w},
+                idx.astype(np.int64))
+
+    def update_priorities(self, idx: np.ndarray,
+                          prios: np.ndarray) -> bool:
+        self.prios[idx] = np.maximum(np.abs(prios), self.eps)
+        return True
+
+    def stats(self) -> Dict[str, float]:
+        live = self.prios[:self.size]
+        return {"size": self.size,
+                "prio_mean": float(live.mean()) if self.size else 0.0,
+                "prio_max": float(live.max()) if self.size else 0.0}
+
+
+class _ApexWorker(_DQNRolloutWorker):
+    """Rollout worker that pushes experience STRAIGHT to a replay shard
+    (reference: apex workers store to replay actors without a driver
+    hop, apex_dqn.py training_step) with worker-side initial TD-error
+    priorities from the online net."""
+
+    def __init__(self, env_creator, spec: PolicySpec, shards: List[Any],
+                 *, gamma: float, rollout_fragment_length: int = 100,
+                 seed: int = 0):
+        super().__init__(env_creator, spec,
+                         rollout_fragment_length=rollout_fragment_length,
+                         seed=seed)
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.policy import MLPPolicy
+
+        self._shards = shards
+        self._shard_rr = seed
+
+        def td_error(params, obs, actions, rewards, next_obs, dones):
+            q, _ = MLPPolicy.forward(params, obs)
+            q_sel = jnp.take_along_axis(
+                q, actions[:, None].astype(jnp.int32), axis=1)[:, 0]
+            q_next, _ = MLPPolicy.forward(params, next_obs)
+            target = rewards + gamma * (1.0 - dones) * jnp.max(q_next,
+                                                               axis=1)
+            return jnp.abs(q_sel - target)
+
+        self._td = jax.jit(td_error)
+
+    def sample_and_store(self, params, epsilon: float) -> Dict[str, Any]:
+        batch = self.sample(params, epsilon)
+        returns = batch.pop("completed_returns")
+        prios = np.asarray(self._td(
+            params, batch["obs"], batch["actions"], batch["rewards"],
+            batch["next_obs"], batch["dones"]))
+        shard = self._shards[self._shard_rr % len(self._shards)]
+        self._shard_rr += 1
+        # Fire-and-forget into the shard; the ref resolves shard-side.
+        shard.add_batch.remote(batch, prios)
+        return {"steps": len(batch["actions"]),
+                "completed_returns": returns}
+
+
+class ApexDQN(Algorithm):
+    """Distributed prioritized-replay DQN (reference: apex_dqn.py:
+    overlapped sample/store/train with priority feedback)."""
+
+    def setup(self) -> None:
+        import ray_tpu
+
+        config = self.config
+        self.learner = DQNLearner(self.spec, config)
+        shard_cls = ray_tpu.remote(_ReplayShard)
+        self.replay_shards = [
+            shard_cls.options(num_cpus=0).remote(
+                config.buffer_size // config.num_replay_shards,
+                config.obs_dim, config.prioritized_replay_alpha,
+                config.prioritized_replay_eps, config.seed + 31 * i)
+            for i in range(config.num_replay_shards)
+        ]
+        worker_cls = ray_tpu.remote(_ApexWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=1).remote(
+                config.env_creator, self.spec, self.replay_shards,
+                gamma=config.gamma,
+                rollout_fragment_length=config.rollout_fragment_length,
+                seed=config.seed + 1 + i)
+            for i in range(config.num_rollout_workers)
+        ]
+        self._inflight: Dict[Any, Any] = {}   # sample task ref -> worker
+        self._sample_rr = 0
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self.timesteps_total / max(1, c.epsilon_decay_steps))
+        return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        eps = self._epsilon()
+        weights = self.learner.get_weights()
+        # Keep one sample_and_store task in flight per worker; relaunch
+        # with fresh weights as they complete (the Ape-X overlap: env
+        # stepping never waits for the learner).
+        for w in self.workers:
+            if w not in self._inflight.values():
+                self._inflight[w.sample_and_store.remote(weights, eps)] = w
+        ready, _ = ray_tpu.wait(list(self._inflight),
+                                num_returns=1, timeout=60)
+        steps = 0
+        returns: List[float] = []
+        for ref in ready:
+            worker = self._inflight.pop(ref)
+            out = ray_tpu.get(ref)
+            steps += out["steps"]
+            returns.extend(out["completed_returns"])
+            self._inflight[worker.sample_and_store.remote(weights, eps)] = \
+                worker
+
+        # Train from the shards, feeding updated TD priorities back.
+        learn_metrics: Dict[str, float] = {}
+        sizes = ray_tpu.get([s.stats.remote() for s in self.replay_shards])
+        total = sum(int(s["size"]) for s in sizes)
+        updates = 0
+        if total >= c.learning_starts:
+            for _ in range(c.num_sgd_iters):
+                shard = self.replay_shards[
+                    self._sample_rr % len(self.replay_shards)]
+                self._sample_rr += 1
+                out = ray_tpu.get(shard.sample.remote(
+                    c.train_batch_size, c.prioritized_replay_beta))
+                if out is None:
+                    continue
+                batch, idx = out
+                learn_metrics = self._weighted_update(batch)
+                shard.update_priorities.remote(
+                    idx, learn_metrics.pop("_td_abs"))
+                updates += 1
+        return {
+            "timesteps_this_iter": steps,
+            "epsilon": eps,
+            "replay_total": total,
+            "replay_shards": len(self.replay_shards),
+            "learner_updates_this_iter": updates,
+            "episode_return_mean":
+                float(np.mean(returns)) if returns else None,
+            **learn_metrics,
+        }
+
+    def _weighted_update(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """One importance-weighted double-DQN TD update (one jitted XLA
+        program; weights multiply the per-sample Huber loss, the
+        PER correction). Returns metrics plus per-sample |TD| for the
+        priority feedback."""
+        import jax
+
+        lrn = self.learner
+        if not hasattr(self, "_wupdate"):
+            self._wupdate = self._build_weighted_update()
+        lrn.params, lrn.opt_state, aux = self._wupdate(
+            lrn.params, lrn.target_params, lrn.opt_state, dict(batch))
+        lrn.num_updates += 1
+        if lrn.num_updates % lrn._target_freq == 0:
+            lrn.target_params = jax.tree.map(lambda x: x, lrn.params)
+        td_abs = np.asarray(aux.pop("td_abs"))
+        out = {k: float(v) for k, v in aux.items()}
+        out["_td_abs"] = td_abs   # raw |TD| are the new priorities
+        return out
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for s in self.replay_shards:
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+        self.replay_shards = []
+        super().stop()
+
+    def _build_weighted_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.policy import MLPPolicy
+
+        gamma, double_q = self.config.gamma, self.config.double_q
+        optimizer = self.learner.optimizer
+
+        def loss_fn(params, target_params, batch):
+            q, _ = MLPPolicy.forward(params, batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            q_next_t, _ = MLPPolicy.forward(target_params,
+                                            batch["next_obs"])
+            if double_q:
+                q_next_o, _ = MLPPolicy.forward(params, batch["next_obs"])
+                a_star = jnp.argmax(q_next_o, axis=1)
+                next_v = jnp.take_along_axis(
+                    q_next_t, a_star[:, None], axis=1)[:, 0]
+            else:
+                next_v = jnp.max(q_next_t, axis=1)
+            target = batch["rewards"] + gamma * \
+                (1.0 - batch["dones"]) * jax.lax.stop_gradient(next_v)
+            td = q_sel - target
+            huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                              jnp.abs(td) - 0.5)
+            loss = jnp.mean(batch["weights"] * huber)
+            return loss, {"td_abs": jnp.abs(td), "loss": loss,
+                          "q_mean": jnp.mean(q_sel)}
+
+        def update(params, target_params, opt_state, batch):
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            return params, opt_state, aux
+
+        return jax.jit(update)
+
+
+ApexDQNConfig._algo_cls = ApexDQN

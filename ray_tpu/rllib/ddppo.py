@@ -1,0 +1,195 @@
+"""DD-PPO: decentralized distributed PPO (reference:
+``rllib/algorithms/ddppo/ddppo.py`` — learning happens ON the rollout
+workers, gradients sync via torch.distributed allreduce, :90/:173 backend
+config, :220 the no-central-learner training_step).
+
+TPU-first mapping: each gang member hosts env sampling AND a jitted PPO
+learner; after every minibatch the gradient (raveled to one flat vector)
+is averaged through the collective layer — ``store`` backend for
+CPU-rollout gangs, ``xla_dist`` when members are chip-bound and the
+allreduce should ride ICI as one compiled XLA program. There is no
+central learner and no weight broadcast in steady state: ranks start
+identical (rank-0 broadcast at join) and stay identical because every
+rank applies the same averaged gradient — the DDP invariant held by
+construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.policy import PolicySpec
+from ray_tpu.rllib.ppo import PPOConfig, PPOLearner
+
+
+@dataclasses.dataclass
+class DDPPOConfig(PPOConfig):
+    """DD-PPO config (reference: ddppo.py:90 DDPPOConfig — keep_local_
+    weights_in_sync / torch_distributed_backend become the collective
+    backend choice here)."""
+
+    collective_backend: str = "store"   # "xla_dist" for chip-bound gangs
+
+
+class _DDPPOWorker:
+    """One decentralized rank: rollout sampling + local learner + grad
+    allreduce (reference: ddppo.py:220 — workers call their own
+    learn_on_batch; the distributed hook syncs grads)."""
+
+    def __init__(self, env_creator, spec: PolicySpec, config: DDPPOConfig,
+                 world: int, rank: int, group_name: str):
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        self.sampler = RolloutWorker(
+            env_creator, spec, gamma=config.gamma, lam=config.lam,
+            rollout_fragment_length=config.rollout_fragment_length,
+            seed=config.seed + 1 + rank)
+        self.learner = PPOLearner(spec, config)
+        self.world = world
+        self.rank = rank
+        self._group_name = group_name
+        self._backend = config.collective_backend
+        self._group = None
+        self._np_rng = np.random.default_rng(config.seed + 101 + rank)
+
+    def join(self) -> bool:
+        """Form the collective group (all ranks must call concurrently)
+        and sync initial weights from rank 0 (reference: ddppo setup's
+        initial state broadcast)."""
+        from jax.flatten_util import ravel_pytree
+
+        from ray_tpu.parallel import collective
+
+        self._group = collective.init_collective_group(
+            self.world, self.rank, backend=self._backend,
+            group_name=self._group_name)
+        flat, unravel = ravel_pytree(self.learner.get_weights())
+        synced = self._group.broadcast(np.asarray(flat), src_rank=0)
+        self.learner.set_weights(unravel(np.asarray(synced)))
+        return True
+
+    def train_iteration(self, num_epochs: int, minibatch_size: int,
+                        batch: Optional[Any] = None) -> Dict[str, Any]:
+        """Sample locally, then SGD with allreduce-averaged gradients.
+        Every rank samples the same fragment length, so minibatch counts
+        match and the collectives stay aligned. ``batch`` can be injected
+        for deterministic equivalence tests."""
+        if batch is None:
+            batch = self.sampler.sample(self.learner.get_weights())
+        returns = list(getattr(batch, "completed_returns", None) or ())
+        mb = min(minibatch_size, batch.count)
+        metrics: Dict[str, float] = {}
+        for _ in range(num_epochs):
+            shuffled = batch.shuffle(self._np_rng)
+            for sub in shuffled.minibatches(mb):
+                metrics = self._allreduce_step(dict(sub))
+        return {"metrics": metrics, "count": batch.count,
+                "returns": returns}
+
+    def _allreduce_step(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        from jax.flatten_util import ravel_pytree
+
+        from ray_tpu.parallel.collective import ReduceOp
+
+        grads, aux = self.learner.compute_grads(batch)
+        flat, unravel = ravel_pytree(grads)
+        avg = self._group.allreduce(np.asarray(flat), op=ReduceOp.AVG)
+        self.learner.apply_grads(unravel(np.asarray(avg)))
+        return aux
+
+    # -- weights / state (any rank speaks for the gang; writes fan out) --
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, w) -> bool:
+        self.learner.set_weights(w)
+        return True
+
+    def get_state(self):
+        return self.learner.get_state()
+
+    def set_state(self, state) -> bool:
+        self.learner.set_state(state)
+        return True
+
+
+class _GangLearnerHandle:
+    """Learner facade over the decentralized gang: rank 0 speaks for
+    reads (ranks are replicated); writes fan out to every rank to keep
+    the invariant."""
+
+    def __init__(self, workers: List[Any]):
+        self._workers = workers
+
+    def get_weights(self):
+        import ray_tpu
+
+        return ray_tpu.get(self._workers[0].get_weights.remote())
+
+    def set_weights(self, w) -> None:
+        import ray_tpu
+
+        ray_tpu.get([a.set_weights.remote(w) for a in self._workers])
+
+    def get_state(self):
+        import ray_tpu
+
+        return ray_tpu.get(self._workers[0].get_state.remote())
+
+    def set_state(self, state) -> None:
+        import ray_tpu
+
+        ray_tpu.get([a.set_state.remote(state) for a in self._workers])
+
+
+class DDPPO(Algorithm):
+    """Decentralized PPO: no central learner, no weight shipping — the
+    driver only triggers iterations and aggregates metrics (reference:
+    ddppo.py:220 training_step never moves weights or samples)."""
+
+    def setup(self) -> None:
+        import ray_tpu
+
+        config = self.config
+        n = config.num_rollout_workers
+        gname = f"ddppo_{uuid.uuid4().hex[:8]}"
+        worker_cls = ray_tpu.remote(_DDPPOWorker)
+        self.workers = [
+            worker_cls.options(
+                num_cpus=1,
+                num_tpus=(1 if config.collective_backend == "xla_dist"
+                          else 0)).remote(
+                config.env_creator, self.spec, config,
+                world=n, rank=i, group_name=gname)
+            for i in range(n)
+        ]
+        # Rendezvous runs concurrently across ranks (collective group
+        # formation blocks until the full world joins).
+        ray_tpu.get([w.join.remote() for w in self.workers])
+        self.learner = _GangLearnerHandle(self.workers)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        outs = ray_tpu.get([
+            w.train_iteration.remote(self.config.num_sgd_epochs,
+                                     self.config.sgd_minibatch_size)
+            for w in self.workers
+        ])
+        returns = [r for o in outs for r in o["returns"]]
+        metrics = dict(outs[0]["metrics"])
+        return {
+            "timesteps_this_iter": sum(o["count"] for o in outs),
+            "episode_return_mean":
+                float(np.mean(returns)) if returns else None,
+            **metrics,
+        }
+
+
+DDPPOConfig._algo_cls = DDPPO

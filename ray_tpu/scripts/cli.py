@@ -287,7 +287,10 @@ def cmd_timeline(args) -> int:
         "name": ev["name"], "cat": ev.get("kind", "task"), "ph": "X",
         "ts": ev["start"] * 1e6, "dur": (ev["end"] - ev["start"]) * 1e6,
         "pid": ev.get("node_id", "")[:8], "tid": ev.get("pid", 0),
-        "args": {"status": ev.get("status")},
+        "args": {"status": ev.get("status"),
+                 "trace_id": ev.get("trace_id"),
+                 "span_id": ev.get("span_id"),
+                 "parent_span_id": ev.get("parent_span_id")},
     } for ev in events]
     out = args.output or "timeline.json"
     with open(out, "w") as f:

@@ -52,7 +52,8 @@ class ServeController:
         self._lock = threading.RLock()
         self._running = True
         self._http_port = http_port
-        self._proxy = None
+        self._proxies: Dict[str, dict] = {}   # node_id -> {actor, port}
+        self._proxy_backoff: Dict[str, float] = {}   # node_id -> retry at
         # Long-poll state: key -> monotonically increasing version.
         self._versions: Dict[str, int] = {}
         self._change_cv = threading.Condition()
@@ -78,6 +79,10 @@ class ServeController:
             with self._lock:
                 st = self._deployments.get(name)
                 return list(st.replicas) if st else []
+        if key == "routes":
+            with self._lock:
+                return {n: st.config.get("route_prefix")
+                        for n, st in self._deployments.items()}
         return None
 
     def listen_for_change(self, snapshot_ids: Dict[str, int],
@@ -160,6 +165,7 @@ class ServeController:
             st = self._deployments[name]
         self._scale_to_target(name, st)
         self._bump(f"replicas:{name}")
+        self._bump("routes")
         return True
 
     def delete_deployment(self, name: str) -> bool:
@@ -168,6 +174,7 @@ class ServeController:
         if st is not None:
             self._kill_replicas(st.replicas)
         self._bump(f"replicas:{name}")
+        self._bump("routes")
         return True
 
     def get_replicas(self, name: str) -> List[Any]:
@@ -190,6 +197,8 @@ class ServeController:
                     for n, st in self._deployments.items()}
 
     def shutdown(self) -> bool:
+        import ray_tpu
+
         self._running = False
         with self._change_cv:
             self._change_cv.notify_all()
@@ -197,6 +206,13 @@ class ServeController:
             for st in self._deployments.values():
                 self._kill_replicas(st.replicas)
             self._deployments.clear()
+            proxies = [info["actor"] for info in self._proxies.values()]
+            self._proxies.clear()
+        for p in proxies:
+            try:
+                ray_tpu.kill(p)
+            except Exception:
+                pass
         return True
 
     # ------------------------------------------------------------ reconcile
@@ -216,6 +232,12 @@ class ServeController:
         blocking get per replica)."""
         import ray_tpu
 
+        # Ingress tracks cluster membership: new nodes get a proxy,
+        # dead nodes' entries drop (reference: HTTPState.update).
+        try:
+            self._reconcile_proxies()
+        except Exception:
+            pass
         with self._lock:
             items = list(self._deployments.items())
         if not items:
@@ -354,12 +376,69 @@ class ServeController:
     # ----------------------------------------------------------- HTTP proxy
 
     def _start_proxy(self, port: int):
+        """Bring up ingress: one proxy actor PER NODE (reference:
+        serve/_private/http_state.py:28 HTTPState — proxy-per-node so
+        ingress has no single point of failure and scales with the
+        cluster). The head node's proxy gets the configured port; the
+        reconcile loop keeps the set in step with cluster membership."""
+        self._reconcile_proxies()
+
+    def _reconcile_proxies(self):
         import ray_tpu
         from ray_tpu.serve.proxy import HTTPProxy
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
 
+        if self._http_port is None:
+            return
+        try:
+            nodes = ray_tpu.nodes()
+        except Exception:
+            return
+        alive = {n["NodeID"]: n for n in nodes if n.get("Alive", True)}
+        now = time.time()
+        with self._lock:
+            # Drop proxies whose node died (their actor died with it).
+            for nid in list(self._proxies):
+                if nid not in alive:
+                    self._proxies.pop(nid, None)
+            missing = [nid for nid in alive
+                       if nid not in self._proxies
+                       and self._proxy_backoff.get(nid, 0) <= now]
         cls = ray_tpu.remote(HTTPProxy)
-        self._proxy = cls.remote(port)
-        ray_tpu.get(self._proxy.ready.remote(), timeout=30)
+        for nid in missing:
+            actor = None
+            try:
+                # Head node keeps the configured port (back-compat for
+                # clients of proxy_port()); other nodes request the same
+                # port — on a multi-host cluster it binds cleanly, on a
+                # single-host test cluster the proxy falls back to an
+                # ephemeral port discovered via bound_port().
+                actor = cls.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=nid, soft=True)).remote(self._http_port)
+                port = ray_tpu.get(actor.bound_port.remote(), timeout=10)
+            except Exception:
+                # Don't leak the half-started actor or hammer an
+                # unhealthy node every reconcile tick.
+                if actor is not None:
+                    try:
+                        ray_tpu.kill(actor)
+                    except Exception:
+                        pass
+                with self._lock:
+                    self._proxy_backoff[nid] = time.time() + 15.0
+                continue
+            with self._lock:
+                self._proxies[nid] = {"actor": actor, "port": port}
+                self._proxy_backoff.pop(nid, None)
 
     def proxy_port(self) -> Optional[int]:
         return self._http_port
+
+    def proxy_addresses(self) -> Dict[str, int]:
+        """{node_id: bound_port} of every live ingress proxy."""
+        with self._lock:
+            return {nid: info["port"]
+                    for nid, info in self._proxies.items()}

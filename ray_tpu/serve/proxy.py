@@ -16,11 +16,14 @@ from typing import Optional
 
 class HTTPProxy:
     def __init__(self, port: int):
-        self.port = port
+        self.port = port           # requested; 0 = ephemeral
+        self._bound_port: Optional[int] = None
         self._ready = threading.Event()
-        # Route table + handles are cached (TTL) so the data path does not
-        # hit the controller per request (reference: proxies learn routes
-        # via LongPollClient pushes, http_proxy.py:137).
+        # Route table + handles are cached so the data path does not hit
+        # the controller per request. Primary freshness source is the
+        # PUSH listener below (reference: proxies learn routes via
+        # LongPollClient pushes, http_proxy.py:137); the TTL poll is
+        # bootstrap + fallback.
         self._routes = {}          # name -> route_prefix
         self._routes_at = 0.0
         self._handles = {}         # name -> DeploymentHandle
@@ -28,8 +31,59 @@ class HTTPProxy:
         self._thread = threading.Thread(target=self._serve_thread,
                                         daemon=True, name="serve-http")
         self._thread.start()
+        threading.Thread(target=self._routes_listener, daemon=True,
+                         name="serve-routes-longpoll").start()
 
     _ROUTES_TTL_S = 1.0
+    _LISTEN_MAX_FAILURES = 8
+
+    def _routes_listener(self):
+        """Long-poll the controller's route-table channel: every proxy
+        learns of deploys/deletes within one notify (reference:
+        http_state.py pushes route tables to all node proxies)."""
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        version = 0
+        failures = 0
+        while True:
+            try:
+                ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+                updates = ray_tpu.get(
+                    ctrl.listen_for_change.remote({"routes": version},
+                                                  25.0), timeout=35)
+            except Exception:
+                failures += 1
+                if failures >= self._LISTEN_MAX_FAILURES:
+                    return   # controller gone (serve.shutdown)
+                import time as _time
+
+                _time.sleep(1.0)
+                continue
+            failures = 0
+            if "routes" in updates:
+                version, routes = updates["routes"]
+                self._install_routes(routes)
+
+    def _install_routes(self, routes):
+        import time as _time
+
+        with self._route_lock:
+            self._routes = dict(routes)
+            self._routes_at = _time.time()
+            dropped = [h for n, h in self._handles.items()
+                       if n not in routes]
+            self._handles = {n: h for n, h in self._handles.items()
+                             if n in routes}
+        for h in dropped:
+            # Stop the dropped handle's push listener — the controller
+            # is alive, so the bounded-failure exit would never fire and
+            # the thread (plus one 25 s long-poll stream) would leak per
+            # deleted deployment.
+            try:
+                h.stop()
+            except Exception:
+                pass
 
     def _route_table(self):
         import time as _time
@@ -45,11 +99,7 @@ class HTTPProxy:
         deployments = ray_tpu.get(ctrl.list_deployments.remote())
         routes = {name: info["config"].get("route_prefix")
                   for name, info in deployments.items()}
-        with self._route_lock:
-            self._routes = routes
-            self._routes_at = now
-            self._handles = {n: h for n, h in self._handles.items()
-                             if n in routes}
+        self._install_routes(routes)
         return dict(routes)
 
     def _handle_for(self, name: str):
@@ -66,6 +116,13 @@ class HTTPProxy:
             raise RuntimeError("HTTP proxy failed to start")
         return True
 
+    def bound_port(self) -> int:
+        """The actually-bound port (differs from the requested one when
+        it was taken — e.g. per-node proxies of a single-host test
+        cluster all asking for the same port)."""
+        self.ready()
+        return self._bound_port
+
     # --------------------------------------------------------------- server
 
     def _serve_thread(self):
@@ -78,8 +135,15 @@ class HTTPProxy:
         app.router.add_route("*", "/{tail:.*}", self._handle)
         runner = web.AppRunner(app)
         await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", self.port)
-        await site.start()
+        try:
+            site = web.TCPSite(runner, "127.0.0.1", self.port)
+            await site.start()
+        except OSError:
+            # Requested port in use: fall back to an ephemeral port
+            # (callers discover it via bound_port()).
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+        self._bound_port = site._server.sockets[0].getsockname()[1]
         self._ready.set()
         while True:
             await asyncio.sleep(3600)

@@ -10,11 +10,11 @@ underperforming trials early; failed trials retry per FailureConfig.
 
 from ray_tpu.tune.search import (  # noqa: F401
     grid_search, choice, uniform, loguniform, randint, sample_from,
-    BasicVariantGenerator,
+    BasicVariantGenerator, Searcher, TPESearcher,
 )
 from ray_tpu.tune.schedulers import (  # noqa: F401
     FIFOScheduler, AsyncHyperBandScheduler, ASHAScheduler,
-    PopulationBasedTraining,
+    HyperBandScheduler, PopulationBasedTraining,
 )
 from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid  # noqa: F401
 from ray_tpu.tune.placement_groups import PlacementGroupFactory  # noqa: F401
@@ -22,8 +22,9 @@ from ray_tpu.train.session import report  # noqa: F401  (tune.report alias)
 
 __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "sample_from", "BasicVariantGenerator", "FIFOScheduler",
-    "AsyncHyperBandScheduler", "ASHAScheduler", "PopulationBasedTraining",
+    "sample_from", "BasicVariantGenerator", "Searcher", "TPESearcher",
+    "FIFOScheduler", "AsyncHyperBandScheduler", "ASHAScheduler",
+    "HyperBandScheduler", "PopulationBasedTraining",
     "TuneConfig", "Tuner", "PlacementGroupFactory",
     "ResultGrid", "report",
 ]

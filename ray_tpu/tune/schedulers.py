@@ -79,6 +79,50 @@ class AsyncHyperBandScheduler:
 ASHAScheduler = AsyncHyperBandScheduler
 
 
+class HyperBandScheduler:
+    """HyperBand (reference: ``tune/schedulers/hyperband.py:40``):
+    s_max+1 brackets trading off number of configurations against budget
+    per configuration — bracket s starts trials with grace period
+    max_t / rf^s, so one bracket explores many short runs while another
+    gives few trials the full budget. Trials are assigned to brackets
+    round-robin on add; within a bracket, rung promotion uses the
+    asynchronous top-1/rf rule (a TPU-first simplification of the
+    reference's synchronous cohort halving: no barrier, no idle chips
+    while a cohort straggles)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 81, reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("min", "max")
+        import math
+
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        s_max = int(math.log(max_t, reduction_factor))
+        self._brackets = []
+        for s in range(s_max, -1, -1):
+            grace = max(1, max_t // (reduction_factor ** s))
+            self._brackets.append(AsyncHyperBandScheduler(
+                metric=metric, mode=mode, max_t=max_t,
+                grace_period=grace, reduction_factor=reduction_factor,
+                time_attr=time_attr))
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def on_trial_add(self, trial_id: str, config: Dict[str, Any]):
+        self._assignment[trial_id] = self._next % len(self._brackets)
+        self._next += 1
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        idx = self._assignment.get(trial_id)
+        if idx is None:   # late registration (searcher-mode trials)
+            self.on_trial_add(trial_id, {})
+            idx = self._assignment[trial_id]
+        return self._brackets[idx].on_result(trial_id, result)
+
+
 class PopulationBasedTraining:
     """PBT (reference: ``tune/schedulers/pbt.py:310``
     PopulationBasedTraining._exploit/_explore): every

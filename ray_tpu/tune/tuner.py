@@ -37,6 +37,11 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Any = None
+    # Model-based searcher (reference: tune/search/searcher.py seam):
+    # when set, trial configs come from search_alg.suggest() as trials
+    # launch (so the model learns from every completed trial) instead of
+    # up-front random/grid variants.
+    search_alg: Any = None
     resources_per_trial: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
 
@@ -126,11 +131,22 @@ class Tuner:
         exp_dir = os.path.join(self.run_config.resolved_storage_path(), name)
         os.makedirs(exp_dir, exist_ok=True)
 
-        variants = BasicVariantGenerator(
-            self.param_space, self.tune_config.num_samples,
-            seed=self.tune_config.seed).variants()
-        trials = [Trial(f"{name}_{i:05d}", cfg)
-                  for i, cfg in enumerate(variants)]
+        searcher = self.tune_config.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(
+                self.tune_config.metric, self.tune_config.mode,
+                self.param_space)
+            # Configs are suggested at LAUNCH, not up front: with
+            # bounded concurrency the model sees completed trials
+            # before proposing the next config.
+            trials = [Trial(f"{name}_{i:05d}", None)
+                      for i in range(self.tune_config.num_samples)]
+        else:
+            variants = BasicVariantGenerator(
+                self.param_space, self.tune_config.num_samples,
+                seed=self.tune_config.seed).variants()
+            trials = [Trial(f"{name}_{i:05d}", cfg)
+                      for i, cfg in enumerate(variants)]
 
         if isinstance(self._trainable, DataParallelTrainer):
             fn_blob = cloudpickle.dumps(
@@ -139,7 +155,7 @@ class Tuner:
             fn_blob = cloudpickle.dumps(self._trainable)
 
         scheduler = self.tune_config.scheduler or FIFOScheduler()
-        if hasattr(scheduler, "on_trial_add"):
+        if hasattr(scheduler, "on_trial_add") and searcher is None:
             for t in trials:
                 scheduler.on_trial_add(t.trial_id, t.config)
         from ray_tpu.tune.placement_groups import PlacementGroupFactory
@@ -147,12 +163,34 @@ class Tuner:
 
         res = self.tune_config.resources_per_trial or {"CPU": 1.0}
         pg_factory = res if isinstance(res, PlacementGroupFactory) else None
-        max_conc = self.tune_config.max_concurrent_trials or \
-            max(1, len(trials))
+        max_conc = self.tune_config.max_concurrent_trials
+        if max_conc is None:
+            # Default concurrency = what the cluster can actually place.
+            # launch() blocks until the trial actor is up, and running
+            # trial actors hold their CPUs until POLLED — launching more
+            # trials than capacity would deadlock the runner on a trial
+            # that can never place (reference: trial runner only starts
+            # trials the executor has resources for,
+            # ray_trial_executor.py:185).
+            if pg_factory is not None:
+                per = sum(b.get("CPU", 0) for b in pg_factory.bundles) \
+                    or 1.0
+            else:
+                per = res.get("CPU", 1.0) or 1.0
+            try:
+                total = ray_tpu.cluster_resources().get("CPU", 0.0)
+            except Exception:
+                total = 0.0
+            max_conc = max(1, int(total // per)) if total else \
+                max(1, len(trials))
         max_failures = self.run_config.failure_config.max_failures
         worker_cls = ray_tpu.remote(TrainWorker)
 
         def launch(trial: Trial):
+            if trial.config is None:
+                trial.config = dict(searcher.suggest(trial.trial_id))
+                if hasattr(scheduler, "on_trial_add"):
+                    scheduler.on_trial_add(trial.trial_id, trial.config)
             opts: Dict[str, Any] = {}
             config = dict(trial.config)
             if pg_factory is not None:
@@ -204,6 +242,8 @@ class Tuner:
                     metrics = dict(rep["metrics"])
                     metrics.setdefault("training_iteration", trial.iteration)
                     trial.reports.append(metrics)
+                    if searcher is not None:
+                        searcher.on_trial_result(trial.trial_id, metrics)
                     if rep["checkpoint_path"]:
                         dst = os.path.join(exp_dir, trial.trial_id,
                                            f"checkpoint_{trial.iteration:06d}")
@@ -234,12 +274,23 @@ class Tuner:
                     else:
                         trial.state = "ERROR"
                         trial.error = st["error"]
+                        if searcher is not None:
+                            searcher.on_trial_complete(trial.trial_id,
+                                                       error=True)
                 elif st["state"] == "finished":
                     self._stop_actor(trial)
                     trial.state = "TERMINATED"
+                    if searcher is not None:
+                        searcher.on_trial_complete(
+                            trial.trial_id, trial.last_metrics())
                 elif stop:
                     self._stop_actor(trial)
                     trial.state = "STOPPED"
+                    if searcher is not None:
+                        # Scheduler-pruned: its best-so-far still informs
+                        # the model (reference: ASHA + searcher compose).
+                        searcher.on_trial_complete(
+                            trial.trial_id, trial.last_metrics())
             time.sleep(_POLL_PERIOD_S)
 
         results = [

@@ -1,0 +1,56 @@
+"""Trace-context propagation across task/actor hops (reference:
+``python/ray/util/tracing/tracing_helper.py:284`` _tracing_task_invocation
+/ :318 _inject_tracing_into_function — a ``_ray_trace_ctx`` kwarg carries
+OpenTelemetry context across process boundaries).
+
+TPU-first simplification: instead of wrapping user functions with an
+injected kwarg, the context rides the task spec itself (``trace_ctx``)
+and spans are emitted through the EXISTING task-event machinery — every
+task event already records start/end/status, so adding
+trace_id/span_id/parent_span_id turns the timeline into a distributed
+trace with zero extra RPCs. Always on (two small fields per spec).
+
+A span is identified by the task id; a trace groups every task
+transitively submitted from one root submission.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import uuid
+from typing import Any, Dict, Optional
+
+_current: contextvars.ContextVar[Optional[Dict[str, str]]] = \
+    contextvars.ContextVar("rtpu_trace_ctx", default=None)
+
+
+def current() -> Optional[Dict[str, str]]:
+    """The active {trace_id, span_id} in this task/driver context."""
+    return _current.get()
+
+
+def for_submit() -> Dict[str, Optional[str]]:
+    """Context to attach to an outgoing task spec: continues the active
+    trace (the submitting task's span becomes the parent), or starts a
+    fresh trace at a driver-side root submission."""
+    ctx = _current.get()
+    if ctx is None:
+        return {"trace_id": uuid.uuid4().hex[:16], "parent_span_id": None}
+    return {"trace_id": ctx["trace_id"], "parent_span_id": ctx["span_id"]}
+
+
+def activate(trace_ctx: Optional[Dict[str, Any]],
+             span_id: str) -> contextvars.Token:
+    """Execution side: make the inbound context current for the duration
+    of the task body (span_id = this task's id). Returns the token for
+    ``deactivate``."""
+    if not trace_ctx:
+        trace_ctx = {"trace_id": uuid.uuid4().hex[:16],
+                     "parent_span_id": None}
+    return _current.set({"trace_id": trace_ctx.get("trace_id"),
+                         "span_id": span_id,
+                         "parent_span_id": trace_ctx.get("parent_span_id")})
+
+
+def deactivate(token: contextvars.Token) -> None:
+    _current.reset(token)

@@ -1,0 +1,80 @@
+"""Ape-X DQN: sharded prioritized replay with priority feedback
+(reference: rllib/algorithms/apex_dqn/apex_dqn.py +
+utils/replay_buffers/prioritized_replay_buffer.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import ApexDQNConfig
+from ray_tpu.rllib.apex import _ReplayShard
+
+
+def _cartpole():
+    import gymnasium as gym
+
+    return gym.make("CartPole-v1")
+
+
+@pytest.fixture
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_prioritized_shard_math():
+    """Unit: sampling concentrates on high-priority entries; importance
+    weights correct for the bias; priority updates take effect."""
+    shard = _ReplayShard(capacity=64, obs_dim=2, alpha=1.0, eps=1e-6,
+                         seed=0)
+    batch = {"obs": np.zeros((10, 2), np.float32),
+             "actions": np.arange(10, dtype=np.int32),
+             "rewards": np.zeros(10, np.float32),
+             "next_obs": np.zeros((10, 2), np.float32),
+             "dones": np.zeros(10, np.float32)}
+    prios = np.ones(10)
+    prios[3] = 100.0     # one dominant transition
+    shard.add_batch(batch, prios)
+    out, idx = shard.sample(512, beta=1.0)
+    frac_3 = float(np.mean(out["actions"] == 3))
+    assert frac_3 > 0.7, frac_3          # p_3 = 100/109 ≈ 0.92
+    # Importance weights: the over-sampled entry gets the SMALLEST
+    # weight (max-normalized).
+    w3 = out["weights"][out["actions"] == 3]
+    w_other = out["weights"][out["actions"] != 3]
+    assert w3.max() < w_other.min()
+    # Feedback: flatten priorities -> sampling spreads back out.
+    shard.update_priorities(np.arange(10), np.ones(10))
+    out2, _ = shard.sample(512, beta=1.0)
+    assert float(np.mean(out2["actions"] == 3)) < 0.3
+
+
+def test_apex_end_to_end(ray_cluster):
+    """Full Ape-X loop on CartPole: experience flows worker -> shard
+    without a driver hop, the learner trains from shards and feeds
+    priorities back, weights refresh, iterations overlap."""
+    algo = (ApexDQNConfig(
+                buffer_size=8000, learning_starts=200,
+                train_batch_size=32, num_sgd_iters=8,
+                num_replay_shards=2, rollout_fragment_length=100)
+            .environment(_cartpole)
+            .rollouts(num_rollout_workers=2)
+            .build())
+    try:
+        total_updates = 0
+        for _ in range(4):
+            m = algo.train()
+            total_updates += m.get("learner_updates_this_iter", 0)
+        assert m["replay_total"] >= 200
+        assert m["replay_shards"] == 2
+        assert total_updates > 0
+        assert "td_abs" not in m        # internal key stripped
+        # Both shards received experience (round-robin pushes).
+        sizes = ray_tpu.get(
+            [s.stats.remote() for s in algo.replay_shards])
+        assert all(s["size"] > 0 for s in sizes), sizes
+        # Priorities are non-uniform after feedback.
+        assert any(s["prio_max"] > s["prio_mean"] for s in sizes), sizes
+    finally:
+        algo.stop()

@@ -1,0 +1,104 @@
+"""DD-PPO: decentralized allreduce training on the collective layer
+(reference: rllib/algorithms/ddppo/ddppo.py:90,173,220 — learning on the
+rollout workers, gradient sync via distributed allreduce, no central
+learner)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import DDPPOConfig
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS, SampleBatch,
+)
+
+
+def _cartpole():
+    import gymnasium as gym
+
+    return gym.make("CartPole-v1")
+
+
+@pytest.fixture
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _synthetic_batch(seed, n=32, obs_dim=4, num_actions=2):
+    rng = np.random.default_rng(seed)
+    return SampleBatch({
+        OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+        ACTIONS: rng.integers(0, num_actions, n).astype(np.int32),
+        LOGPS: rng.normal(scale=0.1, size=n).astype(np.float32),
+        ADVANTAGES: rng.normal(size=n).astype(np.float32),
+        RETURNS: rng.normal(size=n).astype(np.float32),
+    })
+
+
+def _flat(params):
+    from jax.flatten_util import ravel_pytree
+
+    return np.asarray(ravel_pytree(params)[0])
+
+
+def test_ddppo_gradient_equivalence_with_central(ray_cluster):
+    """One decentralized update (2 ranks, different data, allreduce-AVG)
+    must equal the centralized update that applies the equally-weighted
+    mean of the two per-rank gradients — the DDP invariant."""
+    from ray_tpu.rllib.ddppo import _DDPPOWorker
+    from ray_tpu.rllib.policy import PolicySpec
+    from ray_tpu.rllib.ppo import PPOLearner
+
+    cfg = DDPPOConfig(num_rollout_workers=2, rollout_fragment_length=16,
+                      obs_dim=4, num_actions=2, seed=5)
+    cfg.environment(_cartpole)
+    spec = PolicySpec(4, 2)
+    b0, b1 = _synthetic_batch(1), _synthetic_batch(2)
+
+    worker_cls = ray_tpu.remote(_DDPPOWorker)
+    gang = [worker_cls.remote(_cartpole, spec, cfg, 2, r, "eqtest")
+            for r in range(2)]
+    ray_tpu.get([w.join.remote() for w in gang])
+    ray_tpu.get([w.train_iteration.remote(1, 10_000, b)
+                 for w, b in zip(gang, (b0, b1))])
+    w0, w1 = ray_tpu.get([w.get_weights.remote() for w in gang])
+    # Ranks identical after the update (replication invariant).
+    np.testing.assert_allclose(_flat(w0), _flat(w1), atol=1e-6)
+
+    # Centralized reference: same init, mean of per-batch grads, applied
+    # once (LearnerGroup._average with equal counts).
+    import jax
+
+    central = PPOLearner(spec, cfg)
+    g0, _ = central.compute_grads(dict(b0))
+    g1, _ = central.compute_grads(dict(b1))
+    avg = jax.tree.map(lambda a, b: (a + b) / 2, g0, g1)
+    central.apply_grads(avg)
+    np.testing.assert_allclose(_flat(w0), _flat(central.get_weights()),
+                               atol=1e-5)
+    for w in gang:
+        ray_tpu.kill(w)
+
+
+def test_ddppo_end_to_end_stays_in_sync(ray_cluster):
+    """Full DDPPO Algorithm on CartPole: iterations run with NO central
+    learner, ranks remain bit-identical across sampled (different) data,
+    and metrics flow."""
+    algo = (DDPPOConfig(num_sgd_epochs=2, sgd_minibatch_size=64)
+            .environment(_cartpole)
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=64)
+            .build())
+    try:
+        for _ in range(2):
+            metrics = algo.train()
+        assert metrics["timesteps_this_iter"] == 2 * 64
+        assert "total_loss" in metrics
+        w = [ray_tpu.get(a.get_weights.remote()) for a in algo.workers]
+        np.testing.assert_allclose(_flat(w[0]), _flat(w[1]), atol=1e-6)
+        # Checkpoint round-trips through the gang facade.
+        state = algo.learner.get_state()
+        algo.learner.set_state(state)
+    finally:
+        algo.stop()

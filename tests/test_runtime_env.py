@@ -167,3 +167,72 @@ def test_runtime_env_plugin_api(ray_2cpu):
         assert ray_tpu.get(read2.remote(), timeout=60) == "explicit"
     finally:
         renv.unregister_plugin("stamp")
+
+
+def _make_wheel(tmp_path, name, version):
+    """Hand-roll a minimal pure-python wheel (installable offline)."""
+    import zipfile
+
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    dist = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py",
+                    f"__version__ = {version!r}\n")
+        zf.writestr(f"{dist}/METADATA",
+                    f"Metadata-Version: 2.1\nName: {name}\n"
+                    f"Version: {version}\n")
+        zf.writestr(f"{dist}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-"
+                    "Purelib: true\nTag: py3-none-any\n")
+        zf.writestr(f"{dist}/RECORD", "")
+    return str(whl)
+
+
+def test_pip_env_version_isolation(ray_2cpu, tmp_path):
+    """Two CONCURRENT tasks with different pip envs import different
+    versions of the same package (reference: runtime_env/pip.py venv per
+    spec); a third task without the env sees no package at all."""
+    whl1 = _make_wheel(tmp_path, "verpkg", "1.0")
+    whl2 = _make_wheel(tmp_path, "verpkg", "2.0")
+
+    @ray_tpu.remote(runtime_env={"pip": [whl1]})
+    def v1():
+        import verpkg
+        return verpkg.__version__
+
+    @ray_tpu.remote(runtime_env={"pip": [whl2]})
+    def v2():
+        import verpkg
+        return verpkg.__version__
+
+    @ray_tpu.remote
+    def none():
+        try:
+            import verpkg  # noqa: F401
+            return "leaked"
+        except ImportError:
+            return "clean"
+
+    r1, r2, r3 = ray_tpu.get([v1.remote(), v2.remote(), none.remote()],
+                             timeout=180)
+    assert (r1, r2, r3) == ("1.0", "2.0", "clean")
+
+
+def test_pip_env_venv_cached(ray_2cpu, tmp_path):
+    """The same pip spec reuses its cached venv (one venv dir per hash)."""
+    whl = _make_wheel(tmp_path, "cachepkg", "3.1")
+
+    @ray_tpu.remote(runtime_env={"pip": [whl]})
+    def use():
+        import cachepkg
+        return cachepkg.__version__
+
+    assert ray_tpu.get(use.remote(), timeout=120) == "3.1"
+    assert ray_tpu.get(use.remote(), timeout=120) == "3.1"
+    from ray_tpu._private import worker as worker_mod
+
+    session = worker_mod._global_cluster.session_dir
+    pip_root = os.path.join(session, "runtime_resources", "pip")
+    venvs = [d for d in os.listdir(pip_root)
+             if os.path.isdir(os.path.join(pip_root, d))]
+    assert len(venvs) == 1, venvs

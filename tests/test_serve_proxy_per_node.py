@@ -1,0 +1,109 @@
+"""Proxy-per-node ingress (reference: serve/_private/http_state.py:28
+HTTPState starts an HTTPProxyActor on every node; http_proxy.py:415):
+route tables PUSH to all proxies, and ingress survives a proxy node's
+death."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster_utils import Cluster
+
+PORT = 18551
+
+
+@pytest.fixture
+def two_node_serve():
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 3})
+    worker_nm = cluster.add_node(num_cpus=2)
+    cluster.connect(object_store_memory=96 * 1024 * 1024)
+    cluster.wait_for_nodes()
+    serve.start(http_port=PORT)
+    yield cluster, worker_nm
+    serve.shutdown()
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _proxy_ports(deadline_s=30, expect=2):
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        addrs = ray_tpu.get(ctrl.proxy_addresses.remote())
+        if len(addrs) >= expect:
+            return addrs
+        time.sleep(0.3)
+    return ray_tpu.get(ctrl.proxy_addresses.remote())
+
+
+def test_proxy_per_node_and_failover(two_node_serve):
+    cluster, worker_nm = two_node_serve
+
+    @serve.deployment(num_replicas=2)
+    def hello(payload):
+        return {"hello": payload.get("query", {}).get("name", "world")}
+
+    serve.run(hello.bind(), route_prefix="/hello", http_port=PORT)
+
+    # One proxy per node, all serving the SAME route table.
+    addrs = _proxy_ports(expect=2)
+    assert len(addrs) == 2, addrs
+    ports = sorted(addrs.values())
+    for p in ports:
+        out = _get(p, "/hello?name=tpu")
+        assert out == {"hello": "tpu"}
+
+    # Kill the worker node: its proxy (and any replica there) dies.
+    worker_nid = worker_nm.node_id
+    head_ports = [port for nid, port in addrs.items()
+                  if nid != worker_nid]
+    assert head_ports, addrs
+    cluster.remove_node(worker_nm, allow_graceful=False)
+
+    # Ingress on the surviving node keeps working (replicas reconcile
+    # back onto live nodes; handle resubmits through replica death).
+    deadline = time.time() + 60
+    ok = False
+    while time.time() < deadline:
+        try:
+            if _get(head_ports[0], "/hello?name=x",
+                    timeout=10) == {"hello": "x"}:
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert ok
+    # The dead node's proxy drops from the table.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        addrs2 = _proxy_ports(expect=1)
+        if worker_nid not in addrs2:
+            break
+        time.sleep(0.5)
+    assert worker_nid not in addrs2, addrs2
+
+
+def test_route_table_pushes_to_proxies(two_node_serve):
+    """A new deployment is routable on EVERY node's proxy within one
+    push (no TTL wait): deploy, then immediately hit both proxies."""
+    @serve.deployment
+    def ping(payload):
+        return {"pong": True}
+
+    serve.run(ping.bind(), route_prefix="/ping", http_port=PORT)
+    addrs = _proxy_ports(expect=2)
+    for p in addrs.values():
+        assert _get(p, "/ping") == {"pong": True}

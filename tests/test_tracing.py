@@ -1,0 +1,81 @@
+"""Trace-context propagation (reference: util/tracing/tracing_helper.py
+:284,318 — _ray_trace_ctx injected across process hops; here the context
+rides task specs and spans ride the task-event machinery)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _events_by_name(names, timeout=20):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        evs = {e["name"]: e for e in ray_tpu.timeline()
+               if e.get("name") in names}
+        if set(names) <= set(evs):
+            return evs
+        time.sleep(0.2)
+    raise AssertionError(f"events {names} not all reported: {evs}")
+
+
+def test_trace_spans_driver_task_nested(ray_cluster):
+    """driver -> task -> nested task: one trace id, parent links follow
+    the submission chain."""
+    @ray_tpu.remote
+    def inner():
+        return "leaf"
+
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get(inner.remote())
+
+    assert ray_tpu.get(outer.remote(), timeout=60) == "leaf"
+    evs = _events_by_name(["outer", "inner"])
+    o, i = evs["outer"], evs["inner"]
+    assert o["trace_id"] and o["span_id"]
+    assert i["trace_id"] == o["trace_id"]       # same trace
+    assert i["parent_span_id"] == o["span_id"]  # nested under outer
+    assert o["parent_span_id"] is None          # driver-side root
+
+
+def test_trace_spans_actor_hop(ray_cluster):
+    """driver -> actor method -> task submitted from the actor."""
+    @ray_tpu.remote
+    def from_actor():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def call(self):
+            return ray_tpu.get(from_actor.remote())
+
+    a = A.remote()
+    assert ray_tpu.get(a.call.remote(), timeout=60) == 1
+    evs = _events_by_name(["call", "from_actor"])
+    c, f = evs["call"], evs["from_actor"]
+    assert c["trace_id"]
+    assert f["trace_id"] == c["trace_id"]
+    assert f["parent_span_id"] == c["span_id"]
+
+
+def test_separate_roots_get_separate_traces(ray_cluster):
+    @ray_tpu.remote
+    def t_a():
+        return None
+
+    @ray_tpu.remote
+    def t_b():
+        return None
+
+    ray_tpu.get([t_a.remote(), t_b.remote()], timeout=60)
+    evs = _events_by_name(["t_a", "t_b"])
+    assert evs["t_a"]["trace_id"] != evs["t_b"]["trace_id"]
