@@ -15,13 +15,23 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def timed(name, fn, n, unit="ops/s"):
-    t0 = time.perf_counter()
-    fn(n)
-    dt = time.perf_counter() - t0
-    print(json.dumps({"metric": name, "value": round(n / dt, 1),
-                      "unit": unit, "n": n,
-                      "total_s": round(dt, 3)}), flush=True)
+def timed(name, fn, n, unit="ops/s", reps=3):
+    # Warm the path first (conns, caches, allocator, lease ramp): cold
+    # process throughput climbs ~30% over the first seconds of life, and
+    # timing from op 0 measures that ramp, not the steady state the
+    # actor benchmarks (which warm up explicitly) report. Then take the
+    # best of ``reps`` in-process trials: sub-second windows are
+    # preempted by background threads (GC, reporters, conn serving)
+    # bimodally, and a single trial reads as a phantom mode delta.
+    fn(max(1, min(500, n // 10)))
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(n)
+        dt = time.perf_counter() - t0
+        best = max(best, n / dt)
+    print(json.dumps({"metric": name, "value": round(best, 1),
+                      "unit": unit, "n": n, "reps": reps}), flush=True)
 
 
 def main():
@@ -34,15 +44,15 @@ def main():
             for i in range(n):
                 ray_tpu.put(i)
 
-        timed("put_calls_per_s_small", put_small, 2000)
+        timed("put_calls_per_s_small", put_small, 5000)
 
-        refs = [ray_tpu.put(i) for i in range(2000)]
+        refs = [ray_tpu.put(i) for i in range(5000)]
 
         def get_small(n):
             for r in refs[:n]:
                 ray_tpu.get(r)
 
-        timed("get_calls_per_s_small", get_small, 2000)
+        timed("get_calls_per_s_small", get_small, 5000)
 
         # ---- put GB/s, large objects
         blob = np.ones(64 << 20, np.uint8)  # 64 MiB
@@ -53,6 +63,9 @@ def main():
 
         # Keep total put volume under the spill threshold (0.8 x store)
         # so this measures serialization+arena copy, not disk spill.
+        # One warmup put first: the initial large create faults in fresh
+        # arena pages, which is cold-start cost, not copy bandwidth.
+        put_large(1)
         t0 = time.perf_counter()
         put_large(6)
         dt = time.perf_counter() - t0
@@ -65,11 +78,21 @@ def main():
         def nop():
             return None
 
+        # First-task cost (worker spawn + first lease grant) is its own
+        # metric; the throughput loops below measure the steady state,
+        # matching the actor benchmarks (which warm up before timing).
+        t0 = time.perf_counter()
+        ray_tpu.get(nop.remote())
+        print(json.dumps({"metric": "task_cold_start_ms",
+                          "value": round(
+                              (time.perf_counter() - t0) * 1000, 1),
+                          "unit": "ms"}), flush=True)
+
         def tasks_sync(n):
             for _ in range(n):
                 ray_tpu.get(nop.remote())
 
-        timed("tasks_sync_per_s", tasks_sync, 300)
+        timed("tasks_sync_per_s", tasks_sync, 600)
 
         def tasks_async(n):
             ray_tpu.get([nop.remote() for _ in range(n)])
@@ -106,6 +129,28 @@ def main():
                          for _ in range(per)])
 
         timed("actor_calls_nn_per_s", actor_nn, 4000)
+
+        # ---- local-first scheduler: grant/spillback split for this run
+        try:
+            from ray_tpu._private import protocol
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker()
+            addr = w._own_nm_address()
+            stats = w.nm_conn(addr).request(
+                protocol.SCHEDULER_STATS, {}, timeout=10)
+            grants = stats["local_grants_total"]
+            spills = stats["local_spillbacks_total"]
+            if grants + spills:
+                print(json.dumps({
+                    "metric": "scheduler_local_grant_ratio",
+                    "value": round(grants / (grants + spills), 3),
+                    "unit": "ratio",
+                    "local_grants_total": grants,
+                    "local_spillbacks_total": spills,
+                }), flush=True)
+        except Exception:
+            pass   # local scheduling off / NM unreachable: no ratio line
     finally:
         ray_tpu.shutdown()
 

@@ -1,11 +1,17 @@
-"""Lease-on vs lease-off microbenchmark comparison.
+"""A/B microbenchmark comparison for a scheduler toggle.
 
-Runs benchmarks/microbench.py in child processes with the direct task
-transport enabled/disabled (RAY_TPU_LEASE_ENABLED), best of N runs per
-mode, and writes the artifact consumed by the round review
-(MICROBENCH_r{N}.json shape). Run:
+Runs benchmarks/microbench.py in child processes with the chosen toggle
+enabled/disabled, INTERLEAVED best-of-N runs per mode, and writes the
+artifact consumed by the round review (MICROBENCH_r{N}.json shape).
 
-    python benchmarks/microbench_compare.py [rounds] [out.json]
+Toggles:
+  local  (default)  RAY_TPU_LOCAL_SCHEDULING_ENABLED — node-manager
+                    local-first lease grants vs the fully centralized
+                    GCS scheduler
+  lease             RAY_TPU_LEASE_ENABLED — direct task transport
+                    (worker leases) on vs off
+
+Run:  python benchmarks/microbench_compare.py [rounds] [out.json] [toggle]
 """
 
 import json
@@ -15,10 +21,20 @@ import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
+TOGGLES = {
+    "local": ("RAY_TPU_LOCAL_SCHEDULING_ENABLED",
+              "local-first node-manager scheduling (GCS spillback) on vs "
+              "fully centralized GCS scheduling (off also disables the "
+              "worker-lease direct transport: the baseline is the whole "
+              "centralized control+data plane)"),
+    "lease": ("RAY_TPU_LEASE_ENABLED",
+              "direct task transport (worker leases) on vs off"),
+}
 
-def run_once(lease_enabled: bool) -> dict:
+
+def run_once(env_var: str, enabled: bool) -> dict:
     env = dict(os.environ)
-    env["RAY_TPU_LEASE_ENABLED"] = "1" if lease_enabled else "0"
+    env[env_var] = "1" if enabled else "0"
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.setdefault("PALLAS_AXON_POOL_IPS", "")
     proc = subprocess.run(
@@ -32,7 +48,8 @@ def run_once(lease_enabled: bool) -> dict:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            out[rec["metric"]] = rec["value"]
+            if isinstance(rec.get("value"), (int, float)):
+                out[rec["metric"]] = rec["value"]
     if not out:
         raise RuntimeError(f"microbench produced no metrics: "
                            f"{proc.stderr[-500:]}")
@@ -42,22 +59,31 @@ def run_once(lease_enabled: bool) -> dict:
 def main():
     rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 3
     out_path = sys.argv[2] if len(sys.argv) > 2 else None
+    toggle = sys.argv[3] if len(sys.argv) > 3 else "local"
+    env_var, what = TOGGLES[toggle]
     # INTERLEAVED runs (on,off,on,off,...): box-load drift between the
     # two modes' measurement windows otherwise shows up as a phantom
-    # lease regression on paths that never touch the lease manager.
+    # regression on paths that never touch the scheduler.
     on: dict = {}
     off: dict = {}
     for _ in range(rounds):
         for best, enabled in ((on, True), (off, False)):
-            run = run_once(enabled)
+            run = run_once(env_var, enabled)
             for k, v in run.items():
-                best[k] = max(best.get(k, 0.0), v)
-    speedup = {k: round(on[k] / off[k], 2) for k in on if off.get(k)}
+                if k.endswith("_ms"):   # latency: best is the MINIMUM
+                    best[k] = min(best.get(k, v), v)
+                else:
+                    best[k] = max(best.get(k, 0.0), v)
+    # Throughput metrics only: latency (_ms) and ratio metrics have no
+    # meaningful on/off quotient in this orientation.
+    speedup = {k: round(on[k] / off[k], 2) for k in on
+               if off.get(k) and ("per_s" in k or "gb_s" in k)}
     result = {
         "description": f"control-plane microbenchmarks, best of {rounds}; "
-                       f"direct task transport (worker leases) on vs off",
-        "lease_on": on,
-        "lease_off": off,
+                       f"{what}",
+        "toggle": env_var,
+        f"{toggle}_on": on,
+        f"{toggle}_off": off,
         "speedup": speedup,
     }
     text = json.dumps(result, indent=2)

@@ -150,6 +150,25 @@ _d("max_lineage_reconstructions", 3,
    "Times a lost object may be rebuilt by re-running its producing task "
    "(reference: object_recovery_manager.h:41 + task_manager resubmit).")
 
+# --- local-first scheduling (node-manager lease grants) ---------------------
+_d("local_scheduling_enabled", True,
+   "Bottom-up local-first task scheduling (reference: "
+   "raylet/scheduling/policy/hybrid_scheduling_policy.h:50): a caller's "
+   "own node manager grants worker leases from its local free-resource "
+   "ledger without touching the GCS lock; the GCS is informed "
+   "asynchronously (resource deltas riding heartbeats) and is consulted "
+   "synchronously only on spillback (local resources insufficient, "
+   "PG/affinity constraints, actor creation). Off = fully centralized: "
+   "every task placement serializes through the GCS scheduler, and the "
+   "worker-lease direct transport is disabled with it — the off mode is "
+   "the whole centralized control+data plane, not just central lease "
+   "brokering (an A/B against GCS-brokered leases is the 'lease' toggle "
+   "in benchmarks/microbench_compare.py).")
+_d("local_lease_backoff_s", 1.0,
+   "After the GCS signals classic-queue pressure (revoke_local_lease), "
+   "the node manager declines overlapping local grants for this long so "
+   "spilled-back work drains through the fair central queue first.")
+
 # --- direct task transport (worker leases) ---------------------------------
 _d("lease_enabled", True,
    "Stream same-shape tasks directly to leased workers, bypassing the "

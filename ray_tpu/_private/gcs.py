@@ -36,6 +36,7 @@ from ray_tpu._private.task_spec import (
     PlacementGroupSpec,
     ResourceSet,
     TaskSpec,
+    demand_overlaps,
 )
 
 logger = logging.getLogger("ray_tpu.gcs")
@@ -62,6 +63,20 @@ class NodeEntry:
     hw: Dict[str, Any] = field(default_factory=dict)  # reporter sample
     started_at: float = field(default_factory=time.time)
     last_heartbeat: float = field(default_factory=time.time)
+    # Resources held by the node manager's OWN lease grants (local-first
+    # scheduling) — the GCS never acquired these; the aggregate arrives
+    # asynchronously on heartbeats and is subtracted from ``available``
+    # for every central placement decision (reference: the raylet
+    # resource reports feeding cluster_resource_scheduler).
+    local_held: ResourceSet = field(default_factory=ResourceSet)
+    local_held_seq: int = -1  # highest report version applied (NM-local)
+
+    def effective_available(self) -> ResourceSet:
+        """GCS-accounted availability minus locally-held resources (the
+        view central placement must use; negatives clamp to zero)."""
+        if self.local_held.is_zero():
+            return self.available
+        return self.available.minus_clamped(self.local_held)
 
 
 @dataclass
@@ -307,6 +322,13 @@ class GcsServer:
                 self._check_health(now)
                 if self._recovering_actors:
                     self._expire_recovering_actors(now)
+                if len(self._queued_tasks) and now - getattr(
+                        self, "_last_queue_retry", 0.0) >= 0.2:
+                    # Stuck-queue retry: with local-first traffic the GCS
+                    # may see no scheduling-relevant events for a while;
+                    # this keeps revocation/fairness progressing.
+                    self._last_queue_retry = now
+                    self._try_schedule()
             for w in expired:
                 try:
                     w.conn.reply(w.msg_id, {
@@ -413,6 +435,7 @@ class GcsServer:
                 self._mark_node_dead(node_id)
 
     def _h_heartbeat(self, conn, p, msg_id):
+        freed = False
         with self._lock:
             node = self._nodes.get(p["node_id"])
             if node is not None:
@@ -421,6 +444,25 @@ class GcsServer:
                     node.labels["oom_kills"] = str(p["oom_kills"])
                 if "hw" in p:
                     node.hw = p["hw"]
+                if "local_held" in p:
+                    # Async resource delta from the node's local-first
+                    # scheduler: reconcile the central view. Reports are
+                    # sent outside the NM's lock, so they can arrive out
+                    # of order — the seq keeps a stale (older) snapshot
+                    # from overwriting a fresher one. Held resources
+                    # shrinking means capacity came back — queued
+                    # central work may now place.
+                    seq = p.get("local_held_seq", -1)
+                    if seq == -1 or seq > node.local_held_seq:
+                        node.local_held_seq = max(seq,
+                                                  node.local_held_seq)
+                        new = ResourceSet(p["local_held"])
+                        old = node.local_held.to_dict()
+                        node.local_held = new
+                        freed = any(new.get(k) < v
+                                    for k, v in old.items())
+            if freed:
+                self._try_schedule()
 
     def _expire_recovering_actors(self, now: float):
         due = [aid for aid, t in self._recovering_actors.items() if now >= t]
@@ -487,7 +529,10 @@ class GcsServer:
         logger.warning("node %s died", node_id)
         self._drop_client_refs(f"node:{node_id[:12]}")
         # Leases on the dead node die with it (resources went with the node;
-        # holders notice their direct conns closing and fall back).
+        # holders notice their direct conns closing and fall back). The
+        # node manager's own local-first grants die the same way — clear
+        # the held aggregate so fairness never chases a dead node.
+        node.local_held = ResourceSet()
         for lid, lease in list(self._leases.items()):
             if lease["node_id"] == node_id:
                 self._leases.pop(lid, None)
@@ -569,6 +614,8 @@ class GcsServer:
                 available=ResourceSet(p["resources"]),
                 labels=p.get("labels", {}),
                 is_head=p.get("is_head", False),
+                local_held=ResourceSet(p.get("local_held") or {}),
+                local_held_seq=p.get("local_held_seq", -1),
             )
             conn.meta["role"] = "node"
             conn.meta["node_id"] = p["node_id"]
@@ -602,7 +649,8 @@ class GcsServer:
                     "NodeManagerAddress": n.address,
                     "StorePath": n.store_path,
                     "Resources": n.total.to_dict(),
-                    "Available": n.available.to_dict(),
+                    "Available": n.effective_available().to_dict(),
+                    "LocallyHeld": n.local_held.to_dict(),
                     "Labels": dict(n.labels),
                     "IsHead": n.is_head,
                     "Hardware": dict(n.hw),
@@ -622,7 +670,7 @@ class GcsServer:
             total = ResourceSet()
             for n in self._nodes.values():
                 if n.alive:
-                    total.add(n.available.to_dict())
+                    total.add(n.effective_available().to_dict())
             conn.reply(msg_id, total.to_dict())
 
     # ------------------------------------------------------ function store
@@ -736,26 +784,32 @@ class GcsServer:
             if kind == "node_affinity":
                 n = self._nodes.get(strategy.node_id)
                 if n is not None and n.alive and (
-                        strategy.soft or n.available.fits(resources)):
-                    if n.available.fits(resources):
+                        strategy.soft
+                        or n.effective_available().fits(resources)):
+                    if n.effective_available().fits(resources):
                         return n
                     return None  # hard affinity, wait for capacity
                 if not strategy.soft:
                     return None
             elif kind == "spread":
-                feas = [n for n in alive if n.available.fits(resources)]
+                feas = [n for n in alive
+                        if n.effective_available().fits(resources)]
                 if not feas:
                     return None
-                return min(feas, key=lambda n: n.available.utilization(n.total))
+                return min(feas, key=lambda n:
+                           n.effective_available().utilization(n.total))
         if preferred is not None:
             pn = self._nodes.get(preferred)
-            if (pn is not None and pn.alive and pn.available.fits(resources)
-                    and pn.available.utilization(pn.total) < 0.5):
+            if (pn is not None and pn.alive
+                    and pn.effective_available().fits(resources)
+                    and pn.effective_available().utilization(pn.total) < 0.5):
                 return pn
-        feasible = [n for n in alive if n.available.fits(resources)]
+        feasible = [n for n in alive
+                    if n.effective_available().fits(resources)]
         if not feasible:
             return None
-        return min(feasible, key=lambda n: n.available.utilization(n.total))
+        return min(feasible,
+                   key=lambda n: n.effective_available().utilization(n.total))
 
     def _acquire_for(self, spec, node: NodeEntry) -> bool:
         """Reserve resources on a node (or its PG bundle)."""
@@ -869,20 +923,19 @@ class GcsServer:
         return any(n.alive and n.total.fits(demand)
                    for n in self._nodes.values())
 
-    @staticmethod
-    def _demand_overlaps(demand: Dict[str, float],
-                         held: Dict[str, float]) -> bool:
-        """Does freeing/withholding ``held`` help ``demand`` at all?
-        (Revoking a CPU lease cannot unstick a TPU-shaped task.)"""
-        return any(held.get(k, 0) > 0 for k, v in demand.items() if v > 0)
+    # Shared with the node manager's backoff/revoke targeting: both ends
+    # of the lease-fairness protocol must use the same predicate.
+    _demand_overlaps = staticmethod(demand_overlaps)
 
     def _maybe_revoke_lease_locked(self, stuck_demands):
         """Classic-queue fairness: when scheduled work cannot place while
         worker leases hold capacity, revoke one lease (rate-limited).
         Only a lease whose held resources actually compete with a stuck
         (and feasible-on-some-node) demand is revoked; the holder drains
-        it gracefully (lease.py revoke)."""
-        if not self._leases:
+        it gracefully (lease.py revoke). Covers both GCS-brokered leases
+        and node managers' local-first grants (revoked via the NM)."""
+        if not self._leases and all(
+                n.local_held.is_zero() for n in self._nodes.values()):
             return
         feasible = [d for d in stuck_demands
                     if self._feasible_anywhere_locked(d)]
@@ -898,6 +951,21 @@ class GcsServer:
                 target = lid
                 break
         if target is None:
+            # No GCS-brokered lease competes — but a node manager's OWN
+            # grants (local-first scheduling) might. Ask one such node to
+            # revoke a local lease; the freed capacity arrives on its
+            # eager resource report and _try_schedule fires then.
+            for node in self._nodes.values():
+                if node.alive and not node.local_held.is_zero() and any(
+                        self._demand_overlaps(d, node.local_held.to_dict())
+                        for d in feasible):
+                    self._last_lease_revoke = now
+                    try:
+                        node.conn.notify(protocol.REVOKE_LOCAL_LEASE,
+                                         {"demands": feasible})
+                    except Exception:
+                        pass
+                    return
             return
         self._last_lease_revoke = now
         lease = self._leases[target]
@@ -982,6 +1050,8 @@ class GcsServer:
                 return
             node = self._pick_node(resources, None,
                                    preferred=p.get("owner_node"))
+            # _pick_node already filtered on effective_available().fits()
+            # (which implies available fits — effective <= available).
             if node is None or not node.available.acquire(resources):
                 shape = tuple(sorted(resources.items()))
                 self._lease_demand[shape] = (
@@ -1639,7 +1709,7 @@ class GcsServer:
                 slices.setdefault(sl, []).append(n)
         if wants_tpu and slices and len(slices) > 1:
             def slice_load(nodes):
-                return sum(n.available.utilization(n.total)
+                return sum(n.effective_available().utilization(n.total)
                            for n in nodes) / len(nodes)
 
             for _, members in sorted(slices.items(),
@@ -1654,8 +1724,10 @@ class GcsServer:
         spec = entry.spec
         if not alive:
             return False
-        # Work on copies of availability for atomicity.
-        avail = {n.node_id: ResourceSet(n.available.to_dict()) for n in alive}
+        # Work on copies of availability for atomicity (locally-held
+        # resources excluded: the NM's grants own that capacity).
+        avail = {n.node_id: ResourceSet(n.effective_available().to_dict())
+                 for n in alive}
         placement: Dict[int, str] = {}
         strategy = spec.strategy
 

@@ -39,20 +39,46 @@ from ray_tpu._private import protocol
 
 TPU = "TPU"
 
+# Lease-grant latency by source ("local" = granted by the caller's own node
+# manager without touching the GCS; "gcs" = the central spillback path).
+_grant_latency = None
+_grant_latency_lock = threading.Lock()
+
+
+def _grant_latency_hist():
+    global _grant_latency
+    if _grant_latency is None:
+        with _grant_latency_lock:
+            if _grant_latency is None:
+                from ray_tpu.util import metrics
+
+                _grant_latency = metrics.Histogram(
+                    "scheduler_lease_grant_latency_seconds",
+                    "Worker-lease grant latency (request to usable lease)",
+                    boundaries=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                                0.05, 0.1, 0.25, 0.5, 1.0, 2.5],
+                    tag_keys=("source",))
+                # Ship the histogram to the GCS metrics table (and from
+                # there the dashboard's Prometheus /metrics): the process
+                # that grants leases starts the push loop once.
+                metrics.start_reporter()
+    return _grant_latency
+
 
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "conn", "node_id", "nm_address",
                  "inflight", "idle_since", "dead", "shape_key", "pending",
-                 "draining")
+                 "draining", "local")
 
     def __init__(self, lease_id, worker_id, conn, node_id, nm_address,
-                 shape_key):
+                 shape_key, local=False):
         self.lease_id = lease_id
         self.worker_id = worker_id
         self.conn = conn
         self.node_id = node_id
         self.nm_address = nm_address
         self.shape_key = shape_key
+        self.local = local      # granted by the local NM, not the GCS
         self.inflight = 0
         self.idle_since: Optional[float] = time.monotonic()
         self.dead = False
@@ -94,6 +120,19 @@ class LeaseManager:
         self._worker_timeout = float(config.worker_start_timeout_s) + 10.0
         self._bulk_conn = None   # lazy second GCS conn for fallback waves
         self._closed = False
+        # Local-first scheduling: lease requests go to OUR node manager
+        # first (one local round trip, no GCS lock); the GCS-brokered
+        # path below becomes the spillback. Pre-dial the NM so the hot
+        # path never blocks on a connect.
+        self._local_nm_addr: Optional[str] = None
+        if bool(getattr(config, "local_scheduling_enabled", True)):
+            try:
+                addr = worker._own_nm_address()
+                if addr:
+                    worker.nm_conn(addr)
+                    self._local_nm_addr = addr
+            except Exception:
+                pass   # no NM reachable: GCS-brokered grants only
         # Lease acquisition dials node managers / workers (blocking), so it
         # runs here — never on a conn's serve thread.
         self._exec = concurrent.futures.ThreadPoolExecutor(
@@ -190,6 +229,31 @@ class LeaseManager:
     # ------------------------------------------------------ lease acquire
 
     def _request_lease(self, key: tuple):
+        """Local-first: ask OUR node manager for the lease (grant +
+        worker checkout in one local round trip, GCS untouched). The NM
+        declines (None) on insufficient local capacity / TPU shapes /
+        fairness backoff — then the request spills back to the
+        GCS-brokered path (reference: hybrid_scheduling_policy.h
+        local-node-first with spillback)."""
+        t0 = time.perf_counter()
+        addr = self._local_nm_addr
+        nm = self._w.nm_conn_cached(addr) if addr is not None else None
+        if nm is not None:
+            try:
+                fut = nm.request_nowait(protocol.REQUEST_LOCAL_LEASE, {
+                    "client_id": self._w.client_id,
+                    "resources": dict(key),
+                })
+            except BaseException:
+                self._request_gcs_lease(key, t0)
+                return
+            fut.add_done_callback(
+                lambda f: self._exec_submit(
+                    self._on_local_lease_reply, key, t0, f))
+            return
+        self._request_gcs_lease(key, t0)
+
+    def _request_gcs_lease(self, key: tuple, t0: float):
         st = self._shapes.get(key)
         backlog = len(st.queue) if st is not None else 1
         try:
@@ -203,7 +267,7 @@ class LeaseManager:
             self._lease_denied(key)
             return
         fut.add_done_callback(
-            lambda f: self._exec_submit(self._on_lease_reply, key, f))
+            lambda f: self._exec_submit(self._on_lease_reply, key, t0, f))
 
     def _exec_submit(self, fn, *args):
         try:
@@ -211,7 +275,60 @@ class LeaseManager:
         except RuntimeError:   # executor shut down: manager closing
             pass
 
-    def _on_lease_reply(self, key: tuple, f):
+    def _make_direct_handler(self, holder: Dict[str, Any]):
+        def on_msg(conn, mtype, payload, msg_id):
+            if mtype == "lease_tasks_done":
+                lse = holder.get("lease")
+                if lse is not None:
+                    self._on_tasks_done(lse, payload["results"])
+        return on_msg
+
+    def _direct_address(self, grant: Dict[str, Any]) -> str:
+        """Pick the cheapest transport to the leased worker: its AF_UNIX
+        listener when it is on OUR node (always true for local grants;
+        loopback TCP costs ~2x per message), TCP otherwise."""
+        ux = grant.get("direct_address_ux")
+        if ux and grant.get("node_id") == self._w.node_id:
+            return ux
+        return grant["direct_address"]
+
+    def _on_local_lease_reply(self, key: tuple, t0: float, f):
+        try:
+            grant = f.result(0)
+        except BaseException:
+            grant = None
+        if grant is None:
+            # Spillback: the central scheduler owns this shape now (the
+            # requesting slot carries over to the GCS request).
+            self._request_gcs_lease(key, t0)
+            return
+        holder: Dict[str, Any] = {}
+        try:
+            conn = protocol.connect(self._direct_address(grant),
+                                    handler=self._make_direct_handler(holder),
+                                    name="lease-direct")
+        except BaseException:
+            # Never dialed the worker: hand the grant straight back.
+            try:
+                self._w.nm_conn(self._local_nm_addr).notify(
+                    protocol.RETURN_LOCAL_LEASE,
+                    {"lease_id": grant["lease_id"],
+                     "worker_id": grant.get("worker_id")})
+            except Exception:
+                pass
+            self._lease_denied(key)
+            return
+        lease = _Lease(grant["lease_id"], grant["worker_id"], conn,
+                       grant["node_id"], self._local_nm_addr, key,
+                       local=True)
+        try:
+            _grant_latency_hist().observe(time.perf_counter() - t0,
+                                          tags={"source": "local"})
+        except Exception:
+            pass
+        self._install_lease(key, lease, holder)
+
+    def _on_lease_reply(self, key: tuple, t0: float, f):
         try:
             grant = f.result(0)
         except BaseException:
@@ -220,20 +337,15 @@ class LeaseManager:
             self._lease_denied(key)
             return
         holder: Dict[str, Any] = {}
-
-        def on_msg(conn, mtype, payload, msg_id):
-            if mtype == "lease_tasks_done":
-                lse = holder.get("lease")
-                if lse is not None:
-                    self._on_tasks_done(lse, payload["results"])
-
         try:
             nm = self._w.nm_conn(grant["node_address"])
             rep = nm.request("lease_worker", {
                 "resources": dict(key), "lease_id": grant["lease_id"]},
                 timeout=self._worker_timeout)
-            conn = protocol.connect(rep["direct_address"], handler=on_msg,
-                                    name="lease-direct")
+            conn = protocol.connect(
+                self._direct_address({**rep, "node_id": grant["node_id"]}),
+                handler=self._make_direct_handler(holder),
+                name="lease-direct")
         except BaseException:
             # Tell the NM the lease is dead too, so a worker that is still
             # spawning for it is not stranded in LEASED forever.
@@ -251,8 +363,17 @@ class LeaseManager:
             return
         lease = _Lease(grant["lease_id"], rep["worker_id"], conn,
                        grant["node_id"], grant["node_address"], key)
+        try:
+            _grant_latency_hist().observe(time.perf_counter() - t0,
+                                          tags={"source": "gcs"})
+        except Exception:
+            pass
+        self._install_lease(key, lease, holder)
+
+    def _install_lease(self, key: tuple, lease: _Lease,
+                       holder: Dict[str, Any]):
         holder["lease"] = lease
-        conn.on_close = lambda c, l=lease: self._exec_submit(
+        lease.conn.on_close = lambda c, l=lease: self._exec_submit(
             self._on_lease_conn_closed, l)
         to_send = []
         with self._lock:
@@ -478,17 +599,29 @@ class LeaseManager:
             lease.conn.close()
         except Exception:
             pass
-        # Explicit, authoritative return to the node manager (the worker's
-        # own conn-closed notify is only honored when the holder died).
-        try:
-            self._w.nm_conn(lease.nm_address).notify(
-                "return_leased_worker", {"worker_id": lease.worker_id})
-        except Exception:
-            pass
-        try:
-            self._w.gcs.notify("return_lease", {"lease_id": lease.lease_id})
-        except Exception:
-            pass
+        # Explicit, authoritative return (the worker's own conn-closed
+        # notify is only honored when the holder died). Local grants are
+        # returned to the node manager alone — the GCS never brokered
+        # them; it learns via the NM's async resource report.
+        if lease.local:
+            try:
+                self._w.nm_conn(lease.nm_address).notify(
+                    protocol.RETURN_LOCAL_LEASE,
+                    {"lease_id": lease.lease_id,
+                     "worker_id": lease.worker_id})
+            except Exception:
+                pass
+        else:
+            try:
+                self._w.nm_conn(lease.nm_address).notify(
+                    "return_leased_worker", {"worker_id": lease.worker_id})
+            except Exception:
+                pass
+            try:
+                self._w.gcs.notify("return_lease",
+                                   {"lease_id": lease.lease_id})
+            except Exception:
+                pass
         self._fallback_many(requeued)
 
     # ---------------------------------------------------------- get glue
@@ -668,6 +801,15 @@ class LeaseManager:
                 lease.conn.close()
             except Exception:
                 pass
+            if lease.local:
+                try:
+                    self._w.nm_conn(lease.nm_address).notify(
+                        protocol.RETURN_LOCAL_LEASE,
+                        {"lease_id": lease.lease_id,
+                         "worker_id": lease.worker_id})
+                except Exception:
+                    pass
+                continue
             try:
                 self._w.nm_conn(lease.nm_address).notify(
                     "return_leased_worker", {"worker_id": lease.worker_id})

@@ -39,7 +39,9 @@ from ray_tpu._private.task_spec import (
     TPU,
     ActorCreationSpec,
     ActorTaskSpec,
+    ResourceSet,
     TaskSpec,
+    demand_overlaps,
 )
 from ray_tpu.object_store import plasma
 
@@ -119,9 +121,14 @@ class WorkerHandle:
     busy_since: float = 0.0        # when the current task started
     death_reason: str = ""         # e.g. set by the memory monitor
     direct_address: Optional[str] = None  # worker's own task server
+    direct_address_ux: Optional[str] = None  # same, AF_UNIX (same-node)
     lease_reply: Optional[tuple] = None   # (conn, msg_id) awaiting register
     leased_conn: Optional[protocol.Conn] = None  # caller conn holding lease
-    lease_tag: Optional[bytes] = None     # GCS lease_id of the checkout
+    lease_tag: Optional[bytes] = None     # lease_id of the checkout
+    # GCS-brokered checkout: the shape held on the local ledger until return
+    lease_resources: Optional[Dict[str, float]] = None
+    # Local grant: extra fields merged into the deferred register reply
+    lease_grant: Optional[dict] = None
 
 
 class NodeManager:
@@ -171,6 +178,34 @@ class NodeManager:
         total.setdefault("node:" + self.node_id[:12], 1.0)
         self._total_resources = total
 
+        # ---- local-first scheduler state (reference:
+        # raylet/scheduling/policy/hybrid_scheduling_policy.h:50 — the
+        # raylet grants leases from its own resource view; the GCS is the
+        # spillback path). ``_local_avail`` mirrors this node's free
+        # resources: local grants acquire from it directly; GCS-driven
+        # consumption (classic task dispatches, actor creations, brokered
+        # lease checkouts) is force-subtracted as it arrives so the two
+        # schedulers can never jointly oversubscribe the node by more
+        # than one report interval.
+        self._local_avail = ResourceSet(total)
+        # lease_id -> {"resources", "conn", "client_id"}; the aggregate
+        # rides heartbeats to the GCS as ``local_held``.
+        self._local_held = ResourceSet()
+        # Monotonic version of _local_held: reports are sent outside the
+        # lock, so without it a release's (emptier) snapshot racing past
+        # an earlier grant's would leave stale phantom holds at the GCS
+        # until the next heartbeat.
+        self._local_held_seq = 0
+        self._local_grants: Dict[bytes, Dict[str, Any]] = {}
+        self._res_held_tasks: Dict[bytes, Dict[str, float]] = {}
+        self._res_held_actors: Dict[bytes, Dict[str, float]] = {}
+        # Classic-queue fairness: after a GCS revoke_local_lease signal,
+        # overlapping local grants are declined until this deadline.
+        self._local_backoff_until = 0.0
+        self._local_backoff_demands: List[Dict[str, float]] = []
+        self.local_grants_total = 0
+        self.local_spillbacks_total = 0
+
         # Server for workers, remote pullers, and actor-task callers.
         self.server = protocol.Server(self._handle_server, name=f"nm-{node_name}")
         self.server.on_disconnect = self._on_server_disconnect
@@ -198,6 +233,8 @@ class NodeManager:
             "resources": total,
             "labels": self._labels,
             "is_head": is_head,
+            "local_held": self._local_held.to_dict(),
+            "local_held_seq": self._local_held_seq,
         })
         # Rejoin a restarted GCS (reference: raylet re-registration after
         # GCS failover): on conn drop, redial the same address and
@@ -481,9 +518,14 @@ class NodeManager:
                 cur_cpu = self._read_proc_stat()
                 hw = self._sample_hardware(prev_cpu, cur_cpu)
                 prev_cpu = cur_cpu
+                with self._lock:
+                    local_held = self._local_held.to_dict()
+                    held_seq = self._local_held_seq
                 self.gcs.notify("heartbeat", {
                     "node_id": self.node_id,
                     "oom_kills": getattr(self, "oom_kills", 0),
+                    "local_held": local_held,
+                    "local_held_seq": held_seq,
                     "hw": hw})
             except Exception:
                 pass  # disconnected; the rejoin path owns recovery
@@ -542,6 +584,8 @@ class NodeManager:
             "cpu_percent": cpu_percent,
             "mem_total_bytes": mem_total,
             "mem_available_bytes": mem_avail,
+            "sched_local_grants_total": self.local_grants_total,
+            "sched_spillbacks_total": self.local_spillbacks_total,
             "store_used_bytes": store.get("used_bytes"),
             "store_capacity_bytes": store.get("capacity_bytes"),
             "store_objects": store.get("num_objects"),
@@ -576,6 +620,9 @@ class NodeManager:
             except Exception:
                 objects = []
             try:
+                with self._lock:
+                    local_held = self._local_held.to_dict()
+                    held_seq = self._local_held_seq
                 conn.request("register_node", {
                     "node_id": self.node_id,
                     "address": self.address,
@@ -585,6 +632,8 @@ class NodeManager:
                     "is_head": self._is_head,
                     "actors": alive_actors,
                     "objects": objects,
+                    "local_held": local_held,
+                    "local_held_seq": held_seq,
                 }, timeout=30)
             except Exception:
                 try:
@@ -781,11 +830,16 @@ class NodeManager:
     def _on_server_disconnect(self, conn: protocol.Conn):
         wid = conn.meta.get("worker_id")
         if wid is None:
-            # A caller conn: reclaim any workers it was leasing (safety net
-            # for callers that died before ever dialing the worker).
+            # A caller conn: release its local grants and reclaim any
+            # workers it was leasing (safety net for callers that died
+            # before ever dialing the worker).
             with self._lock:
                 leased = [w for w in self._workers.values()
                           if w.leased_conn is conn]
+                dead_grants = [lid for lid, g in self._local_grants.items()
+                               if g["conn"] is conn]
+            for lid in dead_grants:
+                self._release_local_grant(lid)
             for w in leased:
                 self._release_leased_worker(w)
             return
@@ -801,6 +855,12 @@ class NodeManager:
             prev_state = w.state
             w.state = "dead"
             self._workers.pop(w.worker_id, None)
+            # Release local-ledger holds tied to this worker (a brokered
+            # checkout's shape, a local grant's lease tag).
+            dead_lease_tag = w.lease_tag
+            res, w.lease_resources = w.lease_resources, None
+            if res:
+                self._local_avail.release(res)
             if w in self._idle:
                 self._idle.remove(w)
             for key, pool in list(self._tpu_idle.items()):
@@ -817,6 +877,7 @@ class NodeManager:
             w.pending_pushes = []
             actor_id = w.actor_id
             lease_reply, w.lease_reply = w.lease_reply, None
+        self._release_local_grant(dead_lease_tag)
         if lease_reply is not None:
             # Died before registering: tell the waiting lease caller so it
             # can fall back to the scheduled path.
@@ -868,6 +929,9 @@ class NodeManager:
         if actor_id is not None:
             with self._lock:
                 self._actors.pop(actor_id, None)
+                held = self._res_held_actors.pop(actor_id, None)
+                if held:
+                    self._local_avail.release(held)
             try:
                 self.gcs.notify("actor_state", {
                     "actor_id": actor_id,
@@ -911,6 +975,10 @@ class NodeManager:
 
     def _report_task_done(self, task_id: bytes, status: str, objects,
                           error: Optional[str] = None):
+        with self._lock:
+            held = self._res_held_tasks.pop(task_id, None)
+            if held:
+                self._local_avail.release(held)
         try:
             self.gcs.notify("task_done", {
                 "task_id": task_id,
@@ -941,6 +1009,8 @@ class NodeManager:
                     self.store.delete(oid)
             elif mtype == "submit_actor_task":
                 self._on_submit_actor_task(payload)
+            elif mtype == protocol.REVOKE_LOCAL_LEASE:
+                self._on_revoke_local_lease(payload)
             elif mtype == "dump_stacks":
                 # SIGUSR2 -> worker_main's faulthandler prints every
                 # thread's stack to stderr -> per-worker log file -> log
@@ -976,6 +1046,13 @@ class NodeManager:
     def _on_lease_task(self, spec: TaskSpec):
         from ray_tpu._private import runtime_env as renv_mod
 
+        tid = spec.task_id.binary()
+        with self._lock:
+            # Mirror the GCS's resource acquisition on the local ledger
+            # (guarded: _dispatch_queued re-enters here for TPU specs).
+            if tid not in self._res_held_tasks:
+                self._res_held_tasks[tid] = dict(spec.resources)
+                self._local_avail.subtract(spec.resources)
         if renv_mod.needs_isolation(spec.runtime_env):
             # working_dir / py_modules need a dedicated worker whose cwd
             # and sys.path are set at spawn (reference: per-runtime-env
@@ -1212,6 +1289,13 @@ class NodeManager:
                          offthread: bool = False):
         from ray_tpu._private import runtime_env as renv_mod
 
+        aid_b = spec.actor_id.binary()
+        with self._lock:
+            # Mirror the GCS's acquisition (guarded: the runtime_env
+            # branch re-enters off-thread).
+            if aid_b not in self._res_held_actors:
+                self._res_held_actors[aid_b] = dict(spec.resources)
+                self._local_avail.subtract(spec.resources)
         env = dict((spec.runtime_env or {}).get("env_vars", {}))
         cwd, pypaths = None, []
         if renv_mod.needs_isolation(spec.runtime_env):
@@ -1228,6 +1312,7 @@ class NodeManager:
                 # Plugin-provided env vars; explicit env_vars win.
                 env = {**plugin_env, **env}
             except Exception as e:
+                self._release_actor_hold(aid_b)
                 self.gcs.notify("actor_state", {
                     "actor_id": spec.actor_id.binary(), "state": "DEAD",
                     "creation_failed": True,
@@ -1265,6 +1350,7 @@ class NodeManager:
             chips = self._acquire_chips(k)
             if chips is None:
                 # report failure back; GCS will keep it pending
+                self._release_actor_hold(aid_b)
                 self.gcs.notify("actor_state", {
                     "actor_id": spec.actor_id.binary(), "state": "DEAD",
                     "creation_failed": True,
@@ -1383,6 +1469,7 @@ class NodeManager:
                 self.gcs.notify("actor_state", {
                     "actor_id": payload["actor_id"], "state": "DEAD",
                     "creation_failed": True, "error": payload.get("error")})
+                self._release_actor_hold(payload["actor_id"])
                 with self._lock:
                     w = self._actors.pop(payload["actor_id"], None)
                     if w is not None:
@@ -1395,6 +1482,12 @@ class NodeManager:
                         w.no_restart_kill = True
             elif mtype == "lease_worker":
                 self._on_lease_worker(conn, payload, msg_id)
+            elif mtype == protocol.REQUEST_LOCAL_LEASE:
+                self._on_request_local_lease(conn, payload, msg_id)
+            elif mtype == protocol.RETURN_LOCAL_LEASE:
+                self._on_return_local_lease(conn, payload)
+            elif mtype == protocol.SCHEDULER_STATS:
+                conn.reply(msg_id, self._scheduler_stats())
             elif mtype == "abandon_lease":
                 self._on_abandon_lease(conn, payload)
             elif mtype == "kill_leased_worker":
@@ -1468,6 +1561,7 @@ class NodeManager:
                 return
             w.conn = conn
             w.direct_address = p.get("direct_address")
+            w.direct_address_ux = p.get("direct_address_ux")
             conn.meta["worker_id"] = wid
             pushes, w.pending_pushes = w.pending_pushes, []
             if w.state == STARTING:
@@ -1486,7 +1580,10 @@ class NodeManager:
             lconn, lmsg_id = lease_reply
             try:
                 lconn.reply(lmsg_id, {"worker_id": wid,
-                                      "direct_address": w.direct_address})
+                                      "direct_address": w.direct_address,
+                                      "direct_address_ux":
+                                          w.direct_address_ux,
+                                      **(w.lease_grant or {})})
             except protocol.ConnectionClosed:
                 self._release_leased_worker(w)
         for i, (mtype, payload) in enumerate(pushes):
@@ -1511,10 +1608,41 @@ class NodeManager:
     def _on_lease_worker(self, conn, p, msg_id):
         """Check a pooled worker out to a caller's direct task transport
         (reference: raylet lease grant, node_manager.h:508). The GCS has
-        already acquired the lease's resources; here we only provide the
-        process. Replies with the worker's own task-server address; if a
-        fresh worker must spawn, the reply is deferred to registration."""
-        tag = p.get("lease_id")
+        already acquired the lease's resources; mirror that acquisition
+        on the local ledger (so local grants can't double-book the
+        capacity), then provide the process. Replies with the worker's
+        own task-server address; if a fresh worker must spawn, the reply
+        is deferred to registration."""
+        res = dict(p.get("resources") or {})
+        with self._lock:
+            self._local_avail.subtract(res)
+        attached = [False]
+        try:
+            self._checkout_worker(conn, p.get("lease_id"), msg_id,
+                                  lease_resources=res, attached=attached)
+        except BaseException:
+            # If checkout never attached res to a WorkerHandle (spawn
+            # failure), no death/return path will release it — undo the
+            # mirror-subtract or the ledger leaks capacity on every
+            # failed spawn. ``attached`` is set under the NM lock at the
+            # moment of binding (NOT inferred after the fact — a
+            # concurrent disconnect cleanup may already have released
+            # and nulled the binding, and a second release here would
+            # inflate the ledger into permanent oversubscription).
+            if not attached[0]:
+                with self._lock:
+                    self._local_avail.release(res)
+            raise   # generic handler replies error; caller falls back
+
+    def _checkout_worker(self, conn, tag, msg_id,
+                         grant_extra: Optional[dict] = None,
+                         lease_resources: Optional[Dict[str, float]] = None,
+                         attached: Optional[list] = None):
+        """Hand an idle worker (or a fresh spawn, reply deferred to its
+        registration) to a lease holder. ``attached`` (a one-element
+        [False] list) flips True under the lock the moment
+        ``lease_resources`` is bound to a WorkerHandle — from then on
+        the worker's own cleanup paths own the release."""
         with self._lock:
             w = None
             while self._idle:
@@ -1528,17 +1656,200 @@ class NodeManager:
                 w.state = LEASED
                 w.leased_conn = conn
                 w.lease_tag = tag
+                w.lease_resources = lease_resources
                 w.busy_since = time.time()
+                if attached is not None:
+                    attached[0] = True
+            else:
+                # No idle worker — claim an unclaimed in-flight spawn
+                # (boot fill / pool refill) before herding another
+                # process (reference: worker_pool PopWorker reuses
+                # starting workers). The reply defers to registration
+                # exactly like a fresh spawn's. Only SPARE spawns are
+                # claimable: ones the classic _task_queue is counting on
+                # must register into the idle pool or a queued task
+                # strands with nothing left to respawn for it.
+                spare = [cand for cand in self._workers.values()
+                         if cand.state == STARTING and not cand.dedicated
+                         and cand.lease_reply is None
+                         and cand.leased_conn is None
+                         and cand.actor_id is None]
+                if len(spare) > len(self._task_queue):
+                    cand = spare[0]
+                    cand.lease_reply = (conn, msg_id)
+                    cand.leased_conn = conn
+                    cand.lease_tag = tag
+                    cand.lease_grant = grant_extra
+                    cand.lease_resources = lease_resources
+                    cand.busy_since = time.time()
+                    if attached is not None:
+                        attached[0] = True
+                    return
         if w is not None:
             conn.reply(msg_id, {"worker_id": w.worker_id,
-                                "direct_address": w.direct_address})
+                                "direct_address": w.direct_address,
+                                "direct_address_ux": w.direct_address_ux,
+                                **(grant_extra or {})})
             return
         w = self._spawn_worker()
         with self._lock:
             w.lease_reply = (conn, msg_id)
             w.leased_conn = conn
             w.lease_tag = tag
+            w.lease_grant = grant_extra
+            w.lease_resources = lease_resources
             w.busy_since = time.time()
+            if attached is not None:
+                attached[0] = True
+
+    # ------------------------------------------------- local-first scheduler
+    # (reference: raylet/scheduling/policy/hybrid_scheduling_policy.h:50 —
+    # grant on the caller's own node while resources fit; spill back to
+    # the central scheduler otherwise. The GCS learns of local grants
+    # asynchronously: the ``local_held`` aggregate rides heartbeats, with
+    # an eager push on every grant/release so central placement and
+    # fairness never run more than one notify behind.)
+
+    _demand_overlaps = staticmethod(demand_overlaps)
+
+    def _release_actor_hold(self, aid: bytes) -> None:
+        with self._lock:
+            held = self._res_held_actors.pop(aid, None)
+            if held:
+                self._local_avail.release(held)
+
+    def _on_request_local_lease(self, conn, p, msg_id):
+        """Grant (or decline) a worker lease from the local free-resource
+        ledger — worker checkout, resource accounting, and lease-id
+        issuance all happen here without touching the GCS lock. A None
+        reply is spillback: the caller falls back to the GCS-brokered
+        path (insufficient local capacity, TPU shapes whose chip binding
+        happens at spawn, or a classic-queue fairness backoff)."""
+        res = dict(p["resources"])
+        now = time.time()
+        with self._lock:
+            granted = (
+                not self._shutdown
+                and not res.get(TPU)
+                and not (now < self._local_backoff_until
+                         and any(self._demand_overlaps(d, res)
+                                 for d in self._local_backoff_demands))
+                and self._local_avail.acquire(res)
+            )
+            if granted:
+                lease_id = b"nml:" + os.urandom(12)
+                self._local_held.add(res)
+                self._local_held_seq += 1
+                self._local_grants[lease_id] = {
+                    "resources": res, "conn": conn,
+                    "client_id": p.get("client_id", "")}
+                self.local_grants_total += 1
+            else:
+                self.local_spillbacks_total += 1
+        if not granted:
+            conn.reply(msg_id, None)
+            return
+        self._push_resource_report()
+        try:
+            self._checkout_worker(conn, lease_id, msg_id,
+                                  grant_extra={"lease_id": lease_id,
+                                               "node_id": self.node_id})
+        except BaseException:
+            # Checkout failed (e.g. spawn OSError) AFTER the grant was
+            # recorded: the caller never learns the lease_id, so it can
+            # never return it — release here or the capacity is gone
+            # from both schedulers for the life of the caller's conn.
+            self._release_local_grant(lease_id)
+            try:
+                conn.reply(msg_id, None)   # decline -> caller spills back
+            except Exception:
+                pass
+
+    def _release_local_grant(self, lease_id) -> bool:
+        if lease_id is None:
+            return False
+        with self._lock:
+            g = self._local_grants.pop(lease_id, None)
+            if g is None:
+                return False
+            self._local_avail.release(g["resources"])
+            self._local_held.subtract(g["resources"])
+            self._local_held_seq += 1
+        self._push_resource_report()
+        return True
+
+    def _on_return_local_lease(self, conn, p):
+        """Holder returns a locally-granted lease (deliberate return,
+        revocation drain, or abandonment of a worker it never dialed)."""
+        lid = p.get("lease_id")
+        self._release_local_grant(lid)
+        wid = p.get("worker_id")
+        with self._lock:
+            w = self._workers.get(wid) if wid else None
+            if w is not None and w.leased_conn is not conn:
+                w = None   # not yours (stale / re-leased)
+            if w is None and lid is not None:
+                # Worker still spawning for this lease: detach it so
+                # registration routes it to the idle pool instead.
+                w2 = next((x for x in self._workers.values()
+                           if x.lease_tag == lid), None)
+                if w2 is not None and w2.state == STARTING \
+                        and w2.lease_reply is not None:
+                    w2.lease_reply = None
+                    w2.leased_conn = None
+                    w2.lease_tag = None
+                    w2.lease_grant = None
+        if w is not None:
+            self._release_leased_worker(w)
+
+    def _on_revoke_local_lease(self, p):
+        """GCS fairness signal: classic-queue work competing with
+        locally-held resources can't place anywhere. Decline overlapping
+        local grants for a backoff window and ask one holder to drain
+        its lease (it returns via return_local_lease; the freed capacity
+        reaches the GCS on the eager resource report)."""
+        demands = [dict(d) for d in p.get("demands") or []]
+        target = None
+        with self._lock:
+            self._local_backoff_until = time.time() + float(
+                config.local_lease_backoff_s)
+            self._local_backoff_demands = demands
+            for lid, g in self._local_grants.items():
+                if any(self._demand_overlaps(d, g["resources"])
+                       for d in demands):
+                    target = (lid, g["conn"])
+                    break
+        if target is not None:
+            lid, holder = target
+            try:
+                holder.notify(protocol.REVOKE_LEASE, {"lease_id": lid})
+            except protocol.ConnectionClosed:
+                pass
+
+    def _push_resource_report(self):
+        """Eagerly ship the local-grant aggregate to the GCS (the
+        periodic heartbeat is the batched carrier; grant/release edges
+        push immediately so spillback scheduling sees fresh capacity).
+        The seq lets the GCS drop reports that arrive out of order."""
+        with self._lock:
+            held = self._local_held.to_dict()
+            seq = self._local_held_seq
+        try:
+            self.gcs.notify("heartbeat", {
+                "node_id": self.node_id, "local_held": held,
+                "local_held_seq": seq})
+        except Exception:
+            pass
+
+    def _scheduler_stats(self) -> dict:
+        with self._lock:
+            return {
+                "local_grants_total": self.local_grants_total,
+                "local_spillbacks_total": self.local_spillbacks_total,
+                "local_grants_open": len(self._local_grants),
+                "local_held": self._local_held.to_dict(),
+                "local_available": self._local_avail.to_dict(),
+            }
 
     def _on_abandon_lease(self, conn, p):
         """The caller gave up on a lease (grant timeout / connect failure)
@@ -1558,6 +1869,10 @@ class NodeManager:
                 w.lease_reply = None
                 w.leased_conn = None
                 w.lease_tag = None
+                w.lease_grant = None
+                res, w.lease_resources = w.lease_resources, None
+                if res:
+                    self._local_avail.release(res)
                 return
         self._release_leased_worker(w)
 
@@ -1565,10 +1880,16 @@ class NodeManager:
         with self._lock:
             if w.state != LEASED or w.worker_id not in self._workers:
                 return
+            tag = w.lease_tag
+            res, w.lease_resources = w.lease_resources, None
+            if res:
+                self._local_avail.release(res)
             w.state = IDLE
             w.leased_conn = None
             w.lease_tag = None
+            w.lease_grant = None
             self._idle.append(w)
+        self._release_local_grant(tag)
         self._dispatch_queued()
 
     def _on_task_done(self, conn, p):

@@ -28,6 +28,17 @@ from typing import Any, Callable, Dict, Optional, Tuple
 _LEN = struct.Struct("<Q")
 _MAX_FRAME = 1 << 34  # 16 GiB sanity bound
 
+# Local-first scheduling message vocabulary (worker <-> node manager, plus
+# the GCS -> node-manager fairness signal). Message types are plain strings
+# on the wire; these constants keep the three parties (lease.py,
+# node_manager.py, gcs.py) agreeing on the hybrid local-first/spillback
+# protocol (reference: raylet/scheduling/policy/hybrid_scheduling_policy.h).
+REQUEST_LOCAL_LEASE = "request_local_lease"    # caller -> own NM (request)
+RETURN_LOCAL_LEASE = "return_local_lease"      # caller -> own NM (notify)
+REVOKE_LOCAL_LEASE = "revoke_local_lease"      # GCS -> NM (fairness, notify)
+REVOKE_LEASE = "revoke_lease"                  # NM/GCS -> holder (notify)
+SCHEDULER_STATS = "scheduler_stats"            # any -> NM (request)
+
 
 class ConnectionClosed(Exception):
     pass
@@ -86,6 +97,9 @@ class Conn:
         self._send_inflight = False
         self._send_bytes = 0
         self._send_cv = threading.Condition()
+        # Serializes actual socket writes between the writer thread and
+        # the inline fast path in _send (frames must never interleave).
+        self._write_lock = threading.Lock()
         self._writer = threading.Thread(
             target=self._write_loop, daemon=True, name=f"rtpu-send-{name}")
         self._writer.start()
@@ -105,12 +119,52 @@ class Conn:
             self._next_id += 1
             return i
 
+    # Control frames at or under this size try a non-blocking inline
+    # write from the calling thread when the queue is idle — saving the
+    # writer-thread wakeup that otherwise sits on every hot-path message
+    # (task submit, lease result). Bulk frames always take the queue.
+    INLINE_SEND_MAX = 64 * 1024
+
     def _send(self, msg_id, reply_to, mtype, payload, is_error=False):
         data = pickle.dumps((msg_id, reply_to, mtype, payload, is_error),
                             protocol=5)
         frame = _LEN.pack(len(data)) + data
         if self._closed:
             raise ConnectionClosed()
+        # Fast path: empty queue + idle writer -> write inline.
+        # MSG_DONTWAIT preserves the no-blocking-in-handlers guarantee
+        # (two peers both blocked in send() with full buffers would be a
+        # distributed deadlock): a full socket buffer falls through to
+        # the queued path instead of blocking. A send error closes the
+        # conn and drops the frame — exactly the queued path's fate.
+        if len(frame) <= self.INLINE_SEND_MAX and not self._send_q \
+                and self._write_lock.acquire(False):
+            try:
+                if not self._send_q and not self._closed \
+                        and self._acquire_fd():
+                    try:
+                        sent = self._sock.send(frame, socket.MSG_DONTWAIT)
+                    except (BlockingIOError, InterruptedError):
+                        pass          # buffer full: queue it below
+                    except OSError:
+                        self.close()
+                        return
+                    else:
+                        if sent == len(frame):
+                            return
+                        # Partial write: the remainder MUST go out before
+                        # any other frame — front of the queue, while we
+                        # still hold the write lock.
+                        rest = frame[sent:]
+                        with self._send_cv:
+                            self._send_bytes += len(rest)
+                        self._send_q.appendleft(rest)
+                        self._send_ev.set()
+                        return
+                    finally:
+                        self._release_fd()
+            finally:
+                self._write_lock.release()
         if self._send_bytes >= self.MAX_QUEUED_BYTES and \
                 threading.current_thread() is not self._writer:
             with self._send_cv:
@@ -154,15 +208,22 @@ class Conn:
             while True:
                 if not self._send_q:
                     break
-                frame = self._send_q[0]  # pop only after the send completes,
-                self._send_inflight = True  # so flush() can't miss it
-                try:
-                    self._sock.sendall(frame)
-                except (BrokenPipeError, ConnectionResetError, OSError):
+                # q[0] is read AND sent under the write lock: an inline
+                # fast-path sender (_send) that just pushed a partial
+                # frame's remainder to the front must see it go out
+                # before anything else, and frames must never interleave.
+                with self._write_lock:
+                    if not self._send_q:
+                        break
+                    frame = self._send_q[0]  # pop only after the send
+                    self._send_inflight = True  # completes, so flush()
+                    try:                        # can't miss it
+                        self._sock.sendall(frame)
+                    except (BrokenPipeError, ConnectionResetError, OSError):
+                        self._send_inflight = False
+                        self.close()
+                        return
                     self._send_inflight = False
-                    self.close()
-                    return
-                self._send_inflight = False
                 try:
                     self._send_q.popleft()
                 except IndexError:

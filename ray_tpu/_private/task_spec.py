@@ -88,6 +88,25 @@ class ResourceSet:
         for k, v in demand.items():
             self._r[k] = self._r.get(k, 0) + self._fp(v)
 
+    def subtract(self, demand: Dict[str, float]) -> None:
+        """``acquire`` without the fits check: the view may go negative.
+        Used for mirrored accounting (a node manager reflecting grants
+        made elsewhere): an oversubscribed view simply fails ``fits()``
+        until the matching release lands — never wedges."""
+        for k, v in demand.items():
+            self._r[k] = self._r.get(k, 0) - self._fp(v)
+
+    def is_zero(self) -> bool:
+        return all(v == 0 for v in self._r.values())
+
+    def minus_clamped(self, other: "ResourceSet") -> "ResourceSet":
+        """self - other with negatives clamped to zero (an effective-
+        availability view: capacity minus externally-held resources)."""
+        out = ResourceSet()
+        out._r = {k: max(0, v - other._r.get(k, 0))
+                  for k, v in self._r.items()}
+        return out
+
     def add(self, other: Dict[str, float]) -> None:
         for k, v in other.items():
             self._r[k] = self._r.get(k, 0) + self._fp(v)
@@ -102,6 +121,15 @@ class ResourceSet:
 
     def __repr__(self):
         return f"ResourceSet({self.to_dict()})"
+
+
+def demand_overlaps(demand: Dict[str, float],
+                    held: Dict[str, float]) -> bool:
+    """Does freeing/withholding ``held`` help ``demand`` at all?
+    (Revoking a CPU lease cannot unstick a TPU-shaped task.) Shared by
+    the GCS's revoke targeting and the node manager's backoff/revoke
+    targeting — the two ends of the lease-fairness protocol must agree."""
+    return any(held.get(k, 0) > 0 for k, v in demand.items() if v > 0)
 
 
 @dataclass

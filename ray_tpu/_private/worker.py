@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import atexit
 import concurrent.futures
+import queue
 import hashlib
 import os
 import sys
@@ -99,19 +100,87 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def future(self):
-        """concurrent.futures.Future resolving to the object's value."""
-        import concurrent.futures
+        """concurrent.futures.Future resolving to the object's value.
 
+        Resolution runs on a shared bounded pool — a caller creating
+        thousands of futures costs at most ``_FUTURE_POOL_WORKERS``
+        threads, not one daemon thread per call; excess resolutions
+        queue and drain as earlier gets complete (object readiness is
+        driven by remote workers, so queued waiters can't deadlock the
+        pool)."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
 
         def run():
+            if not fut.set_running_or_notify_cancel():
+                return
             try:
                 fut.set_result(require_worker().get([self])[0])
             except BaseException as e:
                 fut.set_exception(e)
 
-        threading.Thread(target=run, daemon=True).start()
+        _future_executor().submit(run)
         return fut
+
+
+_FUTURE_POOL_WORKERS = 16
+
+
+class _DaemonPool:
+    """Bounded pool of DAEMON worker threads for future() resolution.
+
+    Not a ThreadPoolExecutor: its threads are non-daemon and CPython
+    joins them BEFORE atexit handlers run, so one resolver blocked in
+    get() on a never-ready object would hang interpreter exit forever
+    (the atexit disconnect that errors out blocked gets never fires).
+    Daemon threads die with the process, like the old thread-per-call
+    behavior."""
+
+    def __init__(self, max_workers: int, name: str):
+        self._max = max_workers
+        self._name = name
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._threads = 0
+        self._idle = 0
+        self._lock = threading.Lock()
+
+    def submit(self, fn) -> None:
+        self._q.put(fn)
+        with self._lock:
+            if self._idle == 0 and self._threads < self._max:
+                self._threads += 1
+                threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"{self._name}-{self._threads}").start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._idle += 1
+            try:
+                fn = self._q.get()
+            finally:
+                with self._lock:
+                    self._idle -= 1
+            try:
+                fn()
+            except BaseException:
+                pass   # run() owns error delivery via the Future
+
+
+_future_pool: Optional[_DaemonPool] = None
+_future_pool_lock = threading.Lock()
+
+
+def _future_executor() -> _DaemonPool:
+    """Process-wide resolver pool for ObjectRef.future(). Survives
+    init/shutdown cycles; daemon threads never block interpreter exit."""
+    global _future_pool
+    if _future_pool is None:
+        with _future_pool_lock:
+            if _future_pool is None:
+                _future_pool = _DaemonPool(_FUTURE_POOL_WORKERS,
+                                           "rtpu-ref-future")
+    return _future_pool
 
 
 def _restore_ref(id_bytes: bytes, owner_hint: str) -> ObjectRef:
@@ -338,7 +407,10 @@ class CoreWorker:
         if store_path is None:
             raise RuntimeError("no object store available (no nodes?)")
         self.store = plasma.PlasmaClient(store_path)
-        self._nm_address_cache: Optional[str] = None
+        # Workers know their node manager from the spawn env; drivers
+        # resolve it once via the nodes table (lazy).
+        self._nm_address_cache: Optional[str] = \
+            os.environ.get("RAY_TPU_NM_ADDRESS") or None
         # Create-backpressure: on a full store, ask our node manager to
         # spill before failing (reference: plasma CreateRequestQueue).
         self.store.on_full = self._request_spill
@@ -374,11 +446,15 @@ class CoreWorker:
             max(1, int(_cfg.pull_max_inflight_chunks)))
         if _cfg.refcount_enabled:
             self._refs = _RefTracker(self)
-        # Direct task transport (reference: direct_task_transport.h:75):
-        # same-shape tasks stream straight to leased workers after the
-        # first lease, bypassing the GCS scheduler on the hot path.
+        # Local-first task scheduling (reference: the raylet's hybrid
+        # local-first policy + direct_task_transport.h:75): same-shape
+        # tasks stream straight to leased workers, with leases granted by
+        # the caller's OWN node manager when resources fit (GCS consulted
+        # only on spillback). local_scheduling_enabled=0 disables the
+        # whole decentralized path — every task then serializes through
+        # the central GCS scheduler (the A/B baseline).
         self._lease_mgr = None
-        if _cfg.lease_enabled:
+        if _cfg.lease_enabled and _cfg.local_scheduling_enabled:
             from ray_tpu._private.lease import LeaseManager
 
             self._lease_mgr = LeaseManager(self)
@@ -450,6 +526,19 @@ class CoreWorker:
             if lm is not None:
                 lm.note_worker_killed(payload.get("worker_id"),
                                       payload.get("reason", ""))
+        elif mtype == "revoke_lease":
+            # Node manager revoking one of its local grants (classic-
+            # queue fairness): drain and return, same as a GCS revoke.
+            lm = self._lease_mgr
+            if lm is not None:
+                lm.revoke(payload.get("lease_id"))
+
+    def nm_conn_cached(self, address: str) -> Optional[protocol.Conn]:
+        """The cached live conn to a node manager, or None — never dials
+        (safe to call from latency-sensitive paths holding locks)."""
+        with self._nm_lock:
+            conn = self._nm_conns.get(address)
+        return conn if conn is not None and not conn.closed else None
 
     def nm_conn(self, address: str) -> protocol.Conn:
         with self._nm_lock:
@@ -1285,8 +1374,20 @@ class _LocalCluster:
                  system_config=None, port: int = 0):
         from ray_tpu._private.gcs import GcsServer
 
+        # Apply overrides but remember the values they replaced: the
+        # registry is process-global, so without restore-on-shutdown one
+        # cluster's _system_config (e.g. a test's tiny memory budget)
+        # silently governs every later cluster in the process.
+        self._config_restore: dict = {}
         if system_config:
             from ray_tpu._private.config import config as global_config
+            if isinstance(system_config, str):
+                import json as _json
+                system_config = _json.loads(system_config) \
+                    if system_config else {}
+            self._config_restore = {
+                k: global_config.get(k) for k in system_config
+                if k in global_config.dump()}
             global_config.apply_system_config(system_config)
         self.session_dir = os.path.join(
             "/tmp", "ray_tpu", f"session_{int(time.time()*1000)}_{os.getpid()}")
@@ -1317,6 +1418,14 @@ class _LocalCluster:
             self.gcs.close()
         except Exception:
             pass
+        if self._config_restore:
+            from ray_tpu._private.config import config as global_config
+            for k, v in self._config_restore.items():
+                try:
+                    global_config.set(k, v)
+                except Exception:
+                    pass
+            self._config_restore = {}
         import shutil
 
         shutil.rmtree(self.session_dir, ignore_errors=True)

@@ -75,13 +75,30 @@ class WorkerExecutor:
         self.direct = protocol.Server(self._on_direct_msg,
                                       name="worker-direct")
         self.direct.on_disconnect = self._on_direct_disconnect
-
+        # Same-node holders get a unix-socket listener for the same
+        # handler: locally-granted leases are by construction on the
+        # caller's own node, and AF_UNIX halves the per-message round
+        # trip vs loopback TCP (measured ~200us -> ~100us) — this is the
+        # per-task steady-state path, so the saving lands on every task.
+        self.direct_ux = None
+        session_dir = os.environ.get("RAY_TPU_SESSION_DIR")
+        if session_dir:
+            try:
+                self.direct_ux = protocol.Server(
+                    self._on_direct_msg, name="worker-direct-ux",
+                    unix_path=os.path.join(
+                        session_dir, f"w{worker_id.hex()[:12]}.sock"))
+                self.direct_ux.on_disconnect = self._on_direct_disconnect
+            except OSError:
+                self.direct_ux = None   # unbindable path: TCP-only
         self.nm = protocol.connect(nm_address, handler=self._on_msg,
                                    name="worker-nm")
         self.nm.on_close = lambda conn: self._on_nm_closed()
         reply = self.nm.request("register_worker", {
             "worker_id": worker_id, "pid": os.getpid(),
-            "direct_address": self.direct.address})
+            "direct_address": self.direct.address,
+            "direct_address_ux": (self.direct_ux.address
+                                  if self.direct_ux is not None else None)})
         self.node_id = reply["node_id"]
 
     # ------------------------------------------------------------- plumbing
@@ -144,9 +161,13 @@ class WorkerExecutor:
 
     def _on_direct_disconnect(self, conn):
         # The lease holder hung up. Only tell the NM when NO direct conn
-        # remains: a stale old-holder conn closing while the new holder is
-        # connected must not release the new holder's lease.
-        if any(not c.closed for c in self.direct._conns):
+        # remains (on either listener): a stale old-holder conn closing
+        # while the new holder is connected must not release the new
+        # holder's lease.
+        conns = list(self.direct._conns)
+        if self.direct_ux is not None:
+            conns += self.direct_ux._conns
+        if any(not c.closed for c in conns):
             return
         try:
             self.nm.notify("lease_released", None)

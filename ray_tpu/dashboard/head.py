@@ -281,6 +281,14 @@ class DashboardHead:
              "TPU chips on node"),
             ("workers", "ray_tpu_node_workers", "worker processes"),
         ]
+        # Local-first scheduler counters (ride the NM heartbeat's hw
+        # sample; counters, so multi-process aggregation sums them).
+        sched_counters = [
+            ("sched_local_grants_total", "scheduler_local_grants_total",
+             "worker leases granted by the node's local-first scheduler"),
+            ("sched_spillbacks_total", "scheduler_spillbacks_total",
+             "local lease requests spilled back to the GCS"),
+        ]
         for n in nodes:
             hw = n.get("Hardware") or {}
             node12 = n["NodeID"][:12]
@@ -290,6 +298,12 @@ class DashboardHead:
                     out.append({"name": metric,
                                 "tags": {"node": node12}, "value": v,
                                 "kind": "gauge", "help": help_text})
+            for key, metric, help_text in sched_counters:
+                v = hw.get(key)
+                if v is not None:
+                    out.append({"name": metric,
+                                "tags": {"node": node12}, "value": v,
+                                "kind": "counter", "help": help_text})
         for k, v in total.items():
             if k.startswith("node:"):
                 continue

@@ -17,11 +17,20 @@ transitively submitted from one root submission.
 from __future__ import annotations
 
 import contextvars
-import uuid
 from typing import Any, Dict, Optional
 
 _current: contextvars.ContextVar[Optional[Dict[str, str]]] = \
     contextvars.ContextVar("rtpu_trace_ctx", default=None)
+
+
+def _new_trace_id() -> str:
+    # Root-submission trace ids mint on the task-submit hot path; draw
+    # from ids.py's buffered entropy (one urandom syscall per ~1k ids —
+    # a raw uuid4 here costs a getrandom syscall PER TASK, which
+    # dominates submit latency on sandboxed kernels).
+    from ray_tpu._private.ids import _rand_bytes
+
+    return _rand_bytes(8).hex()
 
 
 def current() -> Optional[Dict[str, str]]:
@@ -35,7 +44,7 @@ def for_submit() -> Dict[str, Optional[str]]:
     fresh trace at a driver-side root submission."""
     ctx = _current.get()
     if ctx is None:
-        return {"trace_id": uuid.uuid4().hex[:16], "parent_span_id": None}
+        return {"trace_id": _new_trace_id(), "parent_span_id": None}
     return {"trace_id": ctx["trace_id"], "parent_span_id": ctx["span_id"]}
 
 
@@ -45,7 +54,7 @@ def activate(trace_ctx: Optional[Dict[str, Any]],
     of the task body (span_id = this task's id). Returns the token for
     ``deactivate``."""
     if not trace_ctx:
-        trace_ctx = {"trace_id": uuid.uuid4().hex[:16],
+        trace_ctx = {"trace_id": _new_trace_id(),
                      "parent_span_id": None}
     return _current.set({"trace_id": trace_ctx.get("trace_id"),
                          "span_id": span_id,

@@ -293,6 +293,24 @@ def test_infeasible_queued_task_does_not_block_leases(lease_cluster):
     assert st is not None and any(not l.dead for l in st.leases)
 
 
+def test_lease_grants_are_local_first(lease_cluster):
+    """With local scheduling on (default), steady-state leases come from
+    the caller's own node manager (lease.local), and the grant-latency
+    histogram records them under source="local"."""
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(10)])
+    lm = _lease_mgr()
+    leases = [l for st in lm._shapes.values() for l in st.leases]
+    assert leases and any(l.local for l in leases)
+    from ray_tpu._private.lease import _grant_latency_hist
+    assert any(name.endswith("_count") and tags.get("source") == "local"
+               and value >= 1
+               for name, tags, value in _grant_latency_hist().samples())
+
+
 def test_lease_fast_result_not_stuck_behind_slow(lease_cluster):
     """A fast task's result must reach the caller promptly even when a
     long task runs right behind it on the same leased worker (results

@@ -1,0 +1,234 @@
+"""Local-first task scheduling at the node manager with GCS spillback.
+
+Reference behaviors under test: the hybrid local-first policy
+(src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:50) — a
+caller's own node manager grants worker leases from its local
+free-resource ledger; the GCS is informed asynchronously (``local_held``
+riding heartbeats) and consulted synchronously only on spillback.
+Covered here: the grant-vs-spillback decision matrix, revocation /
+fairness backoff for locally-granted leases, the GCS resource-view
+reconciliation (including after a node manager dies with outstanding
+local grants), and the centralized A/B baseline with the toggle off.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol
+from ray_tpu._private.config import config
+
+
+@pytest.fixture
+def local_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _worker():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker()
+
+
+def _nm():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod._global_cluster.nm
+
+
+def _nm_request(payload, timeout=60):
+    w = _worker()
+    conn = w.nm_conn(w._own_nm_address())
+    return conn.request(protocol.REQUEST_LOCAL_LEASE, payload,
+                        timeout=timeout)
+
+
+def _wait_for(pred, timeout=15, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_tasks_granted_locally(local_cluster):
+    """The steady-state task path is served by the local scheduler: the
+    driver's leases are local grants and the NM counters show it."""
+    @ray_tpu.remote
+    def pid():
+        import os
+        return os.getpid()
+
+    pids = {ray_tpu.get(pid.remote()) for _ in range(10)}
+    assert len(pids) == 1, pids
+    nm = _nm()
+    assert nm.local_grants_total >= 1
+    lm = _worker()._lease_mgr
+    leases = [l for st in lm._shapes.values() for l in st.leases]
+    assert leases and all(l.local for l in leases)
+    # The stats RPC every observer (microbench, tests) uses.
+    stats = _worker().nm_conn(_worker()._own_nm_address()).request(
+        protocol.SCHEDULER_STATS, {}, timeout=10)
+    assert stats["local_grants_total"] >= 1
+    assert stats["local_grants_open"] >= 1
+
+
+def test_grant_vs_spillback_decision_matrix(local_cluster):
+    """Fits-locally -> granted; too big / TPU-shaped / unknown custom
+    resource -> declined (None reply = spill back to the GCS)."""
+    nm = _nm()
+    w = _worker()
+    spill0 = nm.local_spillbacks_total
+    grant = _nm_request({"client_id": w.client_id,
+                         "resources": {"CPU": 1.0}})
+    assert grant is not None
+    assert grant["node_id"] == nm.node_id
+    assert grant["lease_id"].startswith(b"nml:")
+    assert grant["worker_id"] and grant["direct_address"]
+
+    # Exceeds the node's capacity: decline.
+    assert _nm_request({"client_id": w.client_id,
+                        "resources": {"CPU": 64.0}}) is None
+    # TPU shapes bind chips at spawn via the GCS path: decline.
+    assert _nm_request({"client_id": w.client_id,
+                        "resources": {"CPU": 1.0, "TPU": 1.0}}) is None
+    # A custom resource this node doesn't have: decline.
+    assert _nm_request({"client_id": w.client_id,
+                        "resources": {"CPU": 1.0, "gadget": 1.0}}) is None
+    assert nm.local_spillbacks_total >= spill0 + 3
+
+    w.nm_conn(w._own_nm_address()).notify(
+        protocol.RETURN_LOCAL_LEASE,
+        {"lease_id": grant["lease_id"], "worker_id": grant["worker_id"]})
+    _wait_for(lambda: nm._local_held.is_zero(), msg="ledger released")
+    assert not nm._local_grants
+
+
+def test_revoke_signal_backoff_then_recovers(local_cluster):
+    """A GCS revoke_local_lease signal puts overlapping shapes on a
+    fairness backoff (declined -> spilled back to the central queue);
+    after the window the local path grants again."""
+    nm = _nm()
+    w = _worker()
+    old_backoff = config.local_lease_backoff_s
+    config.set("local_lease_backoff_s", 0.4)
+    try:
+        grant = _nm_request({"client_id": w.client_id,
+                             "resources": {"CPU": 1.0}})
+        assert grant is not None
+        nm._on_revoke_local_lease({"demands": [{"CPU": 1.0}]})
+        # Overlapping shape declines during the backoff window.
+        assert _nm_request({"client_id": w.client_id,
+                            "resources": {"CPU": 1.0}}) is None
+        time.sleep(0.6)
+        g2 = _nm_request({"client_id": w.client_id,
+                          "resources": {"CPU": 1.0}})
+        assert g2 is not None
+        for g in (grant, g2):
+            w.nm_conn(w._own_nm_address()).notify(
+                protocol.RETURN_LOCAL_LEASE,
+                {"lease_id": g["lease_id"], "worker_id": g["worker_id"]})
+        _wait_for(lambda: nm._local_held.is_zero(), msg="ledger released")
+    finally:
+        config.set("local_lease_backoff_s", old_backoff)
+
+
+def test_gcs_view_reconciles_local_grants(local_cluster):
+    """Central placement sees local grants: available_resources() (the
+    GCS's effective view) shrinks while a local grant holds capacity and
+    recovers once it is returned — the async resource-delta loop."""
+    nm = _nm()
+    w = _worker()
+    grant = _nm_request({"client_id": w.client_id,
+                         "resources": {"CPU": 2.0}})
+    assert grant is not None
+    _wait_for(lambda: ray_tpu.available_resources().get("CPU") == 2.0,
+              msg="GCS view to reflect the local grant")
+    w.nm_conn(w._own_nm_address()).notify(
+        protocol.RETURN_LOCAL_LEASE,
+        {"lease_id": grant["lease_id"], "worker_id": grant["worker_id"]})
+    _wait_for(lambda: ray_tpu.available_resources().get("CPU") == 4.0,
+              msg="GCS view to recover after the return")
+    assert nm._local_held.is_zero()
+
+
+def test_local_lease_revocation_drains(local_cluster):
+    """Revoking a locally-granted lease held by a real LeaseManager:
+    the holder drains it, returns it to the NM, and the ledger frees —
+    without the GCS ever brokering the lease."""
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])
+    nm = _nm()
+    lm = _worker()._lease_mgr
+    leases = [l for st in lm._shapes.values() for l in st.leases
+              if l.local and not l.dead]
+    assert leases
+    held_before = dict(nm._local_held.to_dict())
+    assert any(v > 0 for v in held_before.values())
+    nm._on_revoke_local_lease({"demands": [{"CPU": 1.0}]})
+    _wait_for(lambda: sum(nm._local_held.to_dict().values())
+              < sum(held_before.values()),
+              msg="a local grant to drain after revocation")
+
+
+def test_nm_death_with_outstanding_local_grants():
+    """A node manager dies while its local grants hold capacity: the GCS
+    drops the node (grants die with it), the cluster view converges to
+    the survivors, and scheduling keeps working."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    node2 = cluster.add_node(num_cpus=2)
+    try:
+        cluster.connect(object_store_memory=64 * 1024 * 1024)
+        assert cluster.wait_for_nodes()
+        w = _worker()
+        conn = w.nm_conn(node2.address)
+        grant = conn.request(protocol.REQUEST_LOCAL_LEASE,
+                             {"client_id": w.client_id,
+                              "resources": {"CPU": 1.0}}, timeout=60)
+        assert grant is not None
+        _wait_for(lambda: ray_tpu.available_resources().get("CPU") == 3.0,
+                  msg="GCS view to reflect node2's local grant")
+        cluster.remove_node(node2)   # dies holding the grant
+        _wait_for(lambda: ray_tpu.available_resources().get("CPU", 0) == 2.0,
+                  timeout=30, msg="GCS view to drop the dead node")
+
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        assert ray_tpu.get([sq.remote(i) for i in range(4)],
+                           timeout=60) == [0, 1, 4, 9]
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_local_scheduling_disabled_is_centralized(monkeypatch):
+    """The A/B baseline: toggle off -> no local grants, every placement
+    serializes through the GCS (classic path), tasks still complete."""
+    monkeypatch.setenv("RAY_TPU_LOCAL_SCHEDULING_ENABLED", "0")
+    config.set("local_scheduling_enabled", False)
+    try:
+        ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        assert ray_tpu.get([sq.remote(i) for i in range(10)]) == \
+            [i * i for i in range(10)]
+        assert _worker()._lease_mgr is None
+        assert _nm().local_grants_total == 0
+    finally:
+        ray_tpu.shutdown()
+        config.set("local_scheduling_enabled", True)

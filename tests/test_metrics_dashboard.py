@@ -115,3 +115,45 @@ def test_node_hardware_reporter(ray_cluster):
         f"http://127.0.0.1:{port}/metrics", timeout=15).read().decode()
     assert "ray_tpu_node_store_capacity_bytes" in text
     assert "ray_tpu_node_mem_total_bytes" in text
+
+
+def test_scheduler_counters_in_prometheus(ray_cluster):
+    """Local-first scheduler counters (grants / spillbacks) ride the NM
+    heartbeat's hardware sample into the GCS nodes view and surface as
+    Prometheus counters on /metrics."""
+    import time as _t
+
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])   # force local grants
+    deadline = _t.time() + 15
+    hw = {}
+    while _t.time() < deadline:   # next heartbeat carries the counters
+        nodes = ray_tpu.nodes()
+        hw = (nodes[0].get("Hardware") or {}) if nodes else {}
+        if hw.get("sched_local_grants_total"):
+            break
+        _t.sleep(0.3)
+    assert hw.get("sched_local_grants_total"), hw
+    assert "sched_spillbacks_total" in hw
+
+    try:
+        _actor, port = start_dashboard(port=18267)
+    except Exception:
+        port = 18265   # an earlier test already started one
+    # The driver-side grant-latency histogram reaches /metrics through
+    # the metrics reporter -> GCS metrics table path; push one sample
+    # batch deterministically instead of waiting for the 5 s loop.
+    from ray_tpu.util import metrics as metrics_mod
+    assert metrics_mod.report_to_gcs()
+
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=15).read().decode()
+    assert "scheduler_local_grants_total" in text
+    assert "scheduler_spillbacks_total" in text
+    assert "scheduler_lease_grant_latency_seconds_bucket" in text
+    assert 'source="local"' in text
