@@ -73,6 +73,83 @@ def main():
                           "value": round(6 * 64 / 1024 / dt, 3),
                           "unit": "GB/s"}), flush=True)
 
+        # ---- device arrays (jax.Array) through the store
+        # put = arena-staged (on: OOB view straight into the slab; off:
+        # legacy pickle-via-host with the tensor in-band). get = arena
+        # rebuild via device_put (the same-process registry is cleared
+        # each iteration so this measures the cross-process path), plus
+        # the same-process by-reference hit ratio and O(1) local get.
+        try:
+            import jax
+
+        except Exception:
+            jax = None
+        if jax is not None:
+            from ray_tpu._private import device_objects
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker()
+            darr = jax.device_put(blob)  # 64 MiB on device
+            jax.block_until_ready(darr)
+            gib = darr.nbytes / (1 << 30)
+
+            def put_device(n):
+                """One timed rep; the staged copies are deleted from the
+                arena between reps (store.delete, refcount 0) so the loop
+                measures staging bandwidth, not eviction/spill churn —
+                the off-path's in-band pickle doubles per-put footprint
+                and outruns async refcount freeing otherwise."""
+                refs_ = []
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    refs_.append(ray_tpu.put(darr))
+                dt = time.perf_counter() - t0
+                for r in refs_:
+                    w.store.delete(r.binary())
+                return dt
+
+            put_device(1)  # fault in arena pages (cold-start, not copy bw)
+            best = 0.0
+            for _ in range(3):
+                best = max(best, 4 * gib / put_device(4))
+            print(json.dumps({"metric": "device_put_gb_s",
+                              "value": round(best, 3),
+                              "unit": "GB/s"}), flush=True)
+
+            dref = ray_tpu.put(darr)
+            best = 0.0
+            for _ in range(3):
+                w._device_local.clear()   # force the arena rebuild path
+                t0 = time.perf_counter()
+                v = ray_tpu.get(dref)
+                jax.block_until_ready(v)
+                dt = time.perf_counter() - t0
+                del v
+                best = max(best, gib / dt)
+            print(json.dumps({"metric": "device_get_gb_s",
+                              "value": round(best, 3),
+                              "unit": "GB/s"}), flush=True)
+
+            device_objects.reset_stats()
+            dref2 = ray_tpu.put(darr)
+            t0 = time.perf_counter()
+            for _ in range(200):
+                ray_tpu.get(dref2)
+            local_ms = (time.perf_counter() - t0) * 1000 / 200
+            s = device_objects.stats()
+            denom = s["local_hits"] + s["rebuilds"]
+            print(json.dumps({"metric": "device_get_local_hit_ratio",
+                              "value": round(
+                                  s["local_hits"] / denom, 3) if denom
+                              else 0.0,
+                              "unit": "ratio",
+                              "local_hits": s["local_hits"],
+                              "rebuilds": s["rebuilds"]}), flush=True)
+            print(json.dumps({"metric": "device_get_local_ms",
+                              "value": round(local_ms, 4),
+                              "unit": "ms"}), flush=True)
+            del darr, dref, dref2
+
         # ---- tasks: sync round-trips and async pipelined
         @ray_tpu.remote
         def nop():

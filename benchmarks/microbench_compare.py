@@ -10,6 +10,10 @@ Toggles:
                     GCS scheduler
   lease             RAY_TPU_LEASE_ENABLED — direct task transport
                     (worker leases) on vs off
+  device            RAY_TPU_DEVICE_OBJECTS_ENABLED — jax.Array as a
+                    first-class store object (arena-staged zero-copy
+                    put/get, by-reference same-process handoff) vs the
+                    legacy pickle-via-host path
 
 Run:  python benchmarks/microbench_compare.py [rounds] [out.json] [toggle]
 """
@@ -29,6 +33,13 @@ TOGGLES = {
               "centralized control+data plane)"),
     "lease": ("RAY_TPU_LEASE_ENABLED",
               "direct task transport (worker leases) on vs off"),
+    "device": ("RAY_TPU_DEVICE_OBJECTS_ENABLED",
+               "device arrays (jax.Array) as first-class store objects — "
+               "arena-staged zero-copy put/get + same-process by-reference "
+               "handoff — on vs off (legacy pickle-via-host: the tensor "
+               "rides in-band in the pickle stream, paying device->host->"
+               "pickle->arena on put and arena->unpickle->host->device on "
+               "get)"),
 }
 
 

@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Documented -fsanitize=thread pass over the store.cpp lock paths
+# (ROADMAP "Native store torture" open item).
+#
+# Rebuilds the shm store library with ThreadSanitizer, preloads libtsan
+# into python (the interpreter itself is uninstrumented, so every report
+# points at a store.cpp lock path, not python internals), and drives
+# benchmarks/tsan_store_stress.py: 8 threads in ONE process racing
+# create/seal/get/evict/delete/stats over a shared oid pool on a tiny
+# arena. Threads, not the fork-based stress test, because TSan shadow
+# memory is per-process — cross-process arena races are invisible to it;
+# ctypes releases the GIL per call, so the threads contend for real.
+# (The fork+SIGKILL robustness torture stays in
+# tests/test_object_store_stress.py under the normal build.)
+#
+# The instrumented library is built in a temp dir and injected via
+# RAY_TPU_STORE_SO — the tracked librtpu_store.so is never touched, and
+# nothing else on the box can accidentally dlopen the TSan build (an
+# uninstrumented process loading it dies on libtsan's static-TLS
+# reservation).
+#
+# Usage: benchmarks/run_tsan_store.sh
+#   TSAN_STRESS_SECONDS=30 for a longer soak (default 8).
+# Findings are summarized on stdout and kept under $TSAN_LOG_DIR
+# (default /tmp). See README "Object store" for the standing findings
+# note from the last documented pass.
+set -uo pipefail
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+SRC="$ROOT/ray_tpu/object_store/store.cpp"
+TMPDIR_TSAN="$(mktemp -d /tmp/rtpu-tsan-XXXXXX)"
+SO="$TMPDIR_TSAN/librtpu_store_tsan.so"
+LOG="${TSAN_LOG_DIR:-/tmp}/rtpu_store_tsan"
+trap 'rm -rf "$TMPDIR_TSAN"' EXIT
+
+echo "== building $(basename "$SO") with -fsanitize=thread"
+g++ -O1 -g -fsanitize=thread -shared -fPIC -pthread -o "$SO" "$SRC" || exit 1
+
+LIBTSAN="$(g++ -print-file-name=libtsan.so)"
+rm -f "$LOG".*
+
+echo "== driving the multithreaded store hammer under TSan"
+LD_PRELOAD="$LIBTSAN" \
+RAY_TPU_STORE_SO="$SO" \
+TSAN_OPTIONS="halt_on_error=0 exitcode=0 log_path=$LOG" \
+python "$ROOT/benchmarks/tsan_store_stress.py" "$@"
+rc=$?
+
+echo
+reports=$(cat "$LOG".* 2>/dev/null | grep -c "WARNING: ThreadSanitizer" \
+    || true)
+echo "== TSan reports: ${reports:-0} (logs: $LOG.*)"
+cat "$LOG".* 2>/dev/null | grep -A 6 "WARNING: ThreadSanitizer" | head -60
+if [ "${reports:-0}" -gt 0 ]; then
+    echo "== TSan flagged the store: triage the logs above"
+    exit 1
+fi
+echo "== clean pass"
+exit $rc

@@ -1,0 +1,105 @@
+"""Multi-THREADED store hammer for the ThreadSanitizer pass
+(``benchmarks/run_tsan_store.sh``).
+
+Why threads, not the fork-based stress test: TSan keeps per-process
+shadow memory, so racing accesses to the shared arena from *different
+processes* are invisible to it — only same-process threads get
+happens-before analysis. ctypes releases the GIL around every store
+call, so N python threads drive store.cpp genuinely concurrently and
+every lock path (robust mutex, seal/get condvar, LRU links, free-list
+coalescing, the rtpu_stats_ex pin scan) runs under real contention.
+
+Deliberately jax-free: importing jax under a libtsan LD_PRELOAD costs
+minutes of instrumented interpreter time and exercises nothing in
+store.cpp.
+
+Run directly (no TSan) as a plain smoke test, or through
+run_tsan_store.sh for the instrumented pass.
+"""
+
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu.object_store import plasma  # noqa: E402
+
+_POOL = 32                  # shared id space => maximum lock contention
+_CAPACITY = 512 * 1024      # tiny arena => constant eviction pressure
+_THREADS = 8
+_SECONDS = float(os.environ.get("TSAN_STRESS_SECONDS", "8"))
+
+
+def _oid(i: int) -> bytes:
+    return b"TS" + i.to_bytes(4, "little") + b"\x00" * 22
+
+
+def _hammer(client: plasma.PlasmaClient, seed: int, stop: threading.Event,
+            errors: list):
+    rng = random.Random(seed)
+    while not stop.is_set():
+        o = _oid(rng.randrange(_POOL))
+        r = rng.random()
+        try:
+            if r < 0.40:
+                buf = client.create(o, rng.randrange(256, 24 * 1024))
+                buf[:4] = b"data"
+                del buf
+                client.seal(o)
+            elif r < 0.70:
+                v = client.get_buffer(o, timeout_ms=rng.choice((0, 5)))
+                if v is not None:
+                    assert bytes(v[:4]) == b"data"
+                    del v
+                    client.release(o)
+            elif r < 0.85:
+                client.delete(o)
+            elif r < 0.95:
+                client.stats_ex()       # rtpu_stats + rtpu_stats_ex scan
+                client.contains(o)
+            else:
+                client.set_allow_evict(rng.random() < 0.9)
+        except (plasma.ObjectExistsError, plasma.StoreFullError):
+            pass
+        except OSError:
+            pass                        # racing delete/evict mid-op
+        except BaseException as e:      # noqa: BLE001
+            errors.append(repr(e))
+            return
+
+
+def main() -> int:
+    path = os.path.join(tempfile.mkdtemp(prefix="tsan-store-"), "arena")
+    plasma.create_store(path, capacity=_CAPACITY, max_objects=256)
+    client = plasma.PlasmaClient(path)
+    client.set_allow_evict(True)
+    stop = threading.Event()
+    errors: list = []
+    threads = [threading.Thread(target=_hammer,
+                                args=(client, i, stop, errors), daemon=True)
+               for i in range(_THREADS)]
+    for t in threads:
+        t.start()
+    time.sleep(_SECONDS)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    stats = client.stats()
+    client.close()
+    os.unlink(path)
+    print(f"tsan-stress: {_THREADS} threads x {_SECONDS:.0f}s, "
+          f"evictions={stats['evictions']}, "
+          f"live_objects={stats['num_objects']}, errors={errors}")
+    if errors or any(t.is_alive() for t in threads):
+        return 1
+    if stats["evictions"] == 0:
+        print("tsan-stress: WARNING eviction path never ran")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

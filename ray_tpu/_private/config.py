@@ -109,6 +109,25 @@ _d("pull_max_inflight_chunks", 8,
 # --- object store -----------------------------------------------------------
 _d("object_store_memory", 2 * 1024 * 1024 * 1024,
    "Default per-node shared-memory object store capacity in bytes.")
+_d("zero_copy_min", 1 * 1024 * 1024,
+   "Objects at or above this many bytes deserialize zero-copy out of the "
+   "shm arena (read-only views, object pinned until the last view is "
+   "collected); below it they are copied out before unpickling. The "
+   "tradeoff: a lower threshold saves memcpy bandwidth on mid-size "
+   "objects but pays pin bookkeeping (a weakref.finalize + a store "
+   "refcount hold per get) and couples eviction to consumer GC — a "
+   "long-lived small view can pin its slot for the life of the process. "
+   "Raise it if the store thrashes on pinned slots; lower it for "
+   "read-heavy numeric workloads. Env: RAY_TPU_ZERO_COPY_MIN.")
+_d("device_objects_enabled", True,
+   "Treat jax.Array as a first-class store object: put stages the device "
+   "buffer host-ward exactly once, directly into the object's arena slab "
+   "(msgpack header + aligned raw bytes); get rebuilds via jax.device_put "
+   "from the read-only arena view (one host->device DMA, pin held until "
+   "the rebuilt array is collected); a get of a ref this process itself "
+   "put returns the original array by reference with zero copies. Off = "
+   "legacy pickle-via-host (device arrays ride IN-BAND in the pickle "
+   "stream) — the A/B baseline in benchmarks/microbench_compare.py.")
 _d("object_store_dir", "/dev/shm",
    "Directory backing the store arena file (tmpfs for zero-copy).")
 _d("object_store_eviction", True, "Enable LRU eviction when full.")

@@ -569,7 +569,9 @@ class NodeManager:
             store = {}
         else:
             try:
-                store = self.store.stats()
+                # stats_ex: includes the O(max_objects) pin scan — this
+                # is the 1/s heartbeat, the one caller that wants it.
+                store = self.store.stats_ex()
             except Exception:
                 store = {}
         with self._lock:
@@ -589,6 +591,13 @@ class NodeManager:
             "store_used_bytes": store.get("used_bytes"),
             "store_capacity_bytes": store.get("capacity_bytes"),
             "store_objects": store.get("num_objects"),
+            # Pin + device-staging accounting (store.cpp rtpu_stats_ex):
+            # pinned bytes are the store's non-reclaimable floor (held by
+            # zero-copy readers); staged bytes meter device-array DMA
+            # traffic into this node's arena.
+            "store_pinned_objects": store.get("pinned_objects"),
+            "store_pinned_bytes": store.get("pinned_bytes"),
+            "device_staged_bytes": store.get("device_staged_bytes"),
             "tpu_chips_total": total_chips,
             "tpu_chips_free": free_chips,
             "workers": workers,

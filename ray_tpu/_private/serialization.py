@@ -14,8 +14,10 @@ Wire layout of a stored object (64-byte aligned buffers for zero-copy numpy):
 
 from __future__ import annotations
 
+import io
 import pickle
 import struct
+import sys
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import cloudpickle
@@ -25,12 +27,54 @@ _ALIGN = 64
 _HEADER = struct.Struct("<IIQ")
 _BUF_DESC = struct.Struct("<QQ")
 
-class SerializedObject:
-    __slots__ = ("metadata", "buffers")
+# Pluggable reducer hook (device arrays — _private/device_objects.py): a
+# callable consulted for every object the pickler visits; returns a reduce
+# tuple to take over serialization of that object, or None to fall through
+# to default pickling. Installed lazily the first time jax is importable so
+# non-jax processes never pay the isinstance probe.
+_reducer_hook: Optional[Callable[[Any], Optional[tuple]]] = None
 
-    def __init__(self, metadata: bytes, buffers: Sequence[memoryview]):
+
+def register_reducer_hook(fn: Callable[[Any], Optional[tuple]]) -> None:
+    global _reducer_hook
+    _reducer_hook = fn
+
+
+class _HookedPickler(cloudpickle.Pickler):
+    """cloudpickle with the registered reducer hook consulted first."""
+
+    def reducer_override(self, obj):
+        r = _reducer_hook(obj)
+        if r is not None:
+            return r
+        return super().reducer_override(obj)
+
+
+def _maybe_install_device_hook() -> None:
+    """Install the device-array reducer once jax exists in this process.
+    Cheap when idle (one sys.modules probe); a no-op forever in processes
+    that never import jax."""
+    if _reducer_hook is not None or "jax" not in sys.modules:
+        return
+    try:
+        from ray_tpu._private import device_objects
+
+        device_objects.maybe_install()
+    except Exception:
+        pass
+
+
+class SerializedObject:
+    __slots__ = ("metadata", "buffers", "device_bytes")
+
+    def __init__(self, metadata: bytes, buffers: Sequence[memoryview],
+                 device_bytes: int = 0):
         self.metadata = metadata
         self.buffers = list(buffers)
+        # Raw device-array bytes staged into this object's buffers: the
+        # plasma client charges these to the arena-wide staging counter
+        # on seal (node-manager staging-bytes accounting).
+        self.device_bytes = device_bytes
 
     def total_size(self) -> int:
         size = _HEADER.size + _BUF_DESC.size * len(self.buffers)
@@ -65,13 +109,28 @@ def _aligned(offset: int) -> int:
 
 
 def serialize(value: Any) -> SerializedObject:
+    _maybe_install_device_hook()
     buffers: List[pickle.PickleBuffer] = []
 
     def buffer_callback(pb: pickle.PickleBuffer) -> bool:
         buffers.append(pb)
         return False  # do not serialize in-band
 
-    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    device_bytes = 0
+    if _reducer_hook is None:
+        meta = cloudpickle.dumps(value, protocol=5,
+                                 buffer_callback=buffer_callback)
+    else:
+        from ray_tpu._private import device_objects
+
+        # Drop bytes a FAILED earlier dump left in the thread ledger —
+        # otherwise they would be mischarged to this unrelated object.
+        device_objects.take_pending_stage_bytes()
+        with io.BytesIO() as f:
+            _HookedPickler(f, protocol=5,
+                           buffer_callback=buffer_callback).dump(value)
+            meta = f.getvalue()
+        device_bytes = device_objects.take_pending_stage_bytes()
     views = []
     for pb in buffers:
         try:
@@ -79,7 +138,7 @@ def serialize(value: Any) -> SerializedObject:
         except BufferError:
             # Non-contiguous buffer: fall back to a contiguous copy.
             views.append(memoryview(bytes(pb)))
-    return SerializedObject(meta, views)
+    return SerializedObject(meta, views, device_bytes=device_bytes)
 
 
 def deserialize_framed(view: memoryview) -> Any:

@@ -152,6 +152,13 @@ class TaskSpec:
     placement_group_id: Optional[PlacementGroupID] = None
     placement_group_bundle_index: int = -1
     runtime_env: Optional[dict] = None
+    # Device-object donation (@remote(_donate_result=True)): the executing
+    # worker deletes the producer's jax.Array device buffer the moment the
+    # return value finishes staging into the arena — HBM is released
+    # without waiting for GC, for producers that hand off and move on.
+    # Rides the spec through both the lease direct-transport path and the
+    # GCS-scheduled path (worker_main._store_returns honors it on either).
+    donate_result: bool = False
     submitted_at: float = field(default_factory=time.time)
     # {trace_id, parent_span_id}: carried across hops so task events form
     # a distributed trace (reference: tracing_helper.py:284 _ray_trace_ctx).

@@ -24,12 +24,13 @@ import sys
 import threading
 import time
 import uuid
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
 from ray_tpu import exceptions
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import device_objects, protocol, serialization
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu._private.task_spec import (
     ActorCreationSpec,
@@ -424,6 +425,12 @@ class CoreWorker:
 
         self._exported_functions: set = set()
         self._function_cache: Dict[str, Any] = {}
+        # Same-process device-object handoff (device_objects.py): weak
+        # registry of jax.Arrays this process put/returned, keyed by
+        # object id — a local get returns the original array by
+        # reference, zero copies, never touching store or GCS.
+        self._device_local: "weakref.WeakValueDictionary[bytes, Any]" = \
+            weakref.WeakValueDictionary()
         self._nm_conns: Dict[str, protocol.Conn] = {}
         self._nm_lock = threading.Lock()
         # actor_id bytes -> {"address": str|None, "pending": [...], "info": {}}
@@ -618,6 +625,7 @@ class CoreWorker:
             raise TypeError("Calling put on an ObjectRef is not allowed")
         oid = self.next_put_id()
         size = self.store.put_value(oid.binary(), value)
+        device_objects.note_put(self, oid.binary(), value)
         self.gcs.notify("add_object_locations", {
             "node_id": self.node_id,
             "objects": [(oid.binary(), size)],
@@ -875,9 +883,22 @@ class CoreWorker:
                 raise TypeError(f"get() list items must be ObjectRef, got "
                                 f"{type(r)}")
         ids = [r.binary() for r in refs]
-        failures = self.ensure_local(ids, timeout=timeout)
+        # Same-process device-object handoff: refs whose value this
+        # process itself put resolve by reference — no store read, no
+        # GCS wait, no DMA (the array never left HBM).
+        local_hits: Dict[bytes, Any] = {}
+        for oid in ids:
+            hit = device_objects.lookup_local(self, oid)
+            if hit is not None:
+                local_hits[oid] = hit
+        remaining = [o for o in ids if o not in local_hits]
+        failures = self.ensure_local(remaining, timeout=timeout) \
+            if remaining else {}
         out = []
         for oid in ids:
+            if oid in local_hits:
+                out.append(local_hits[oid])
+                continue
             if oid in failures and not self.store.contains(oid):
                 raise _error_from_reason(failures[oid])
             value, ok = self.store.get_value(oid, timeout_ms=30_000)
@@ -986,8 +1007,15 @@ class CoreWorker:
         need += [v.id_bytes for v in proc_kwargs.values()
                  if isinstance(v, _ObjArg)]
         if need:
-            failures = self.ensure_local(need)
             resolved: Dict[bytes, Any] = {}
+            # Device objects this worker itself produced resolve by
+            # reference (actor chaining steps on one chip stays in HBM).
+            for oid in need:
+                hit = device_objects.lookup_local(self, oid)
+                if hit is not None:
+                    resolved[oid] = hit
+            need = [o for o in need if o not in resolved]
+            failures = self.ensure_local(need) if need else {}
             for oid in need:
                 if oid in failures and not self.store.contains(oid):
                     raise _error_from_reason(failures[oid])
@@ -1012,7 +1040,8 @@ class CoreWorker:
                     scheduling_strategy=None,
                     placement_group=None,
                     placement_group_bundle_index: int = -1,
-                    runtime_env=None) -> List[ObjectRef]:
+                    runtime_env=None,
+                    donate_result: bool = False) -> List[ObjectRef]:
         if runtime_env:
             from ray_tpu._private import runtime_env as renv_mod
 
@@ -1036,6 +1065,7 @@ class CoreWorker:
                                 if placement_group is not None else None),
             placement_group_bundle_index=placement_group_bundle_index,
             runtime_env=runtime_env,
+            donate_result=donate_result,
             trace_ctx=_tracing().for_submit(),
         )
         # Direct transport first: plain tasks stream to a leased worker

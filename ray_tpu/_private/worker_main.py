@@ -25,7 +25,7 @@ import traceback
 from typing import Any, Dict, Optional
 
 from ray_tpu import exceptions
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import device_objects, protocol, serialization
 from ray_tpu._private.ids import ActorID, JobID, TaskID
 from ray_tpu._private.task_spec import ActorCreationSpec, ActorTaskSpec, TaskSpec
 from ray_tpu._private.worker import CoreWorker, set_global_worker
@@ -267,13 +267,28 @@ class WorkerExecutor:
                     f"{type(result).__name__}")
             values = list(result)
         out = []
+        donate = bool(getattr(spec, "donate_result", False))
+        donate_after = []
         for oid, value in zip(ids, values):
             sobj = serialization.serialize(value)
             try:
                 self.core.store.put_serialized(oid.binary(), sobj)
             except plasma.ObjectExistsError:
                 pass
+            # Staging of this slot is complete: register the device
+            # array for same-process by-reference gets (actor/worker
+            # chaining), or queue it for donation. Donation is deferred
+            # until ALL slots are staged — a multi-return task may
+            # return the same array in two slots, and deleting at slot 0
+            # would make slot 1 serialize a dead buffer.
+            if donate:
+                donate_after.append((oid.binary(), value))
+            else:
+                device_objects.note_return(self.core, oid.binary(), value,
+                                           donate=False)
             out.append((oid.binary(), sobj.total_size()))
+        for oid_b, value in donate_after:
+            device_objects.note_return(self.core, oid_b, value, donate=True)
         return out
 
     def _store_dynamic_returns(self, spec, result) -> list:
@@ -293,6 +308,8 @@ class WorkerExecutor:
                 f"generator/iterable, got {type(result).__name__}")
         out = []
         yielded_ids: list = []
+        donate = bool(getattr(spec, "donate_result", False))
+        donate_after: list = []
         for i, value in enumerate(result):
             oid = ObjectID.for_return(spec.task_id, i + 1).binary()
             sobj = serialization.serialize(value)
@@ -300,8 +317,18 @@ class WorkerExecutor:
                 self.core.store.put_serialized(oid, sobj)
             except plasma.ObjectExistsError:
                 pass   # retry of a task killed mid-yield
+            if donate:
+                # Deleting per-yield would pull the buffer out from under
+                # a generator that reuses its yielded array (x = step(x);
+                # yield x) — donation waits until the generator is done.
+                donate_after.append((oid, value))
+            else:
+                device_objects.note_return(self.core, oid, value,
+                                           donate=False)
             yielded_ids.append(oid)
             out.append((oid, sobj.total_size()))
+        for oid, value in donate_after:
+            device_objects.note_return(self.core, oid, value, donate=True)
         gen_oid = spec.return_ids()[0].binary()
         gen_obj = serialization.serialize(ObjectRefGenerator(yielded_ids))
         try:

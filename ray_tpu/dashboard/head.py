@@ -275,6 +275,10 @@ class DashboardHead:
              "object store used"),
             ("store_capacity_bytes", "ray_tpu_node_store_capacity_bytes",
              "object store capacity"),
+            ("store_pinned_objects", "ray_tpu_node_store_pinned_objects",
+             "objects pinned by zero-copy readers/writers (not evictable)"),
+            ("store_pinned_bytes", "ray_tpu_node_store_pinned_bytes",
+             "bytes pinned by zero-copy readers/writers (not evictable)"),
             ("tpu_chips_free", "ray_tpu_node_tpu_chips_free",
              "idle TPU chips"),
             ("tpu_chips_total", "ray_tpu_node_tpu_chips_total",
@@ -288,6 +292,8 @@ class DashboardHead:
              "worker leases granted by the node's local-first scheduler"),
             ("sched_spillbacks_total", "scheduler_spillbacks_total",
              "local lease requests spilled back to the GCS"),
+            ("device_staged_bytes", "ray_tpu_node_device_staged_bytes_total",
+             "device-array bytes DMA-staged into the node's arena"),
         ]
         for n in nodes:
             hw = n.get("Hardware") or {}

@@ -29,6 +29,7 @@ def _release_pin(client: "PlasmaClient", object_id: bytes) -> None:
         pass
 
 from ray_tpu._private import serialization
+from ray_tpu._private.config import config as _config
 from ray_tpu.exceptions import OutOfMemoryError
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -51,7 +52,15 @@ _lib = None
 
 
 def _ensure_built() -> str:
-    """Compile the store library on first use (no install step needed)."""
+    """Compile the store library on first use (no install step needed).
+
+    RAY_TPU_STORE_SO overrides the library path entirely (no build):
+    used by benchmarks/run_tsan_store.sh to load an instrumented build
+    from a temp dir without touching the tracked artifact.
+    """
+    override = os.environ.get("RAY_TPU_STORE_SO")
+    if override:
+        return override
     with _build_lock:
         if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(
             _SRC_PATH
@@ -106,6 +115,10 @@ def _load_lib():
     lib.rtpu_info.restype = ctypes.c_int
     lib.rtpu_stats.argtypes = [p] + [ctypes.POINTER(u64)] * 4
     lib.rtpu_stats.restype = None
+    lib.rtpu_stats_ex.argtypes = [p] + [ctypes.POINTER(u64)] * 3
+    lib.rtpu_stats_ex.restype = None
+    lib.rtpu_add_staged.argtypes = [p, u64]
+    lib.rtpu_add_staged.restype = None
     lib.rtpu_list.argtypes = [p, bp, u64]
     lib.rtpu_list.restype = u64
     lib.rtpu_set_allow_evict.argtypes = [p, ctypes.c_int]
@@ -235,6 +248,28 @@ class PlasmaClient:
             "evictions": ev.value,
         }
 
+    def stats_ex(self) -> dict:
+        """``stats()`` plus pin/staging accounting. The pin numbers cost
+        an O(max_objects) entry scan under the arena lock — fine for the
+        1/s heartbeat and tests, NOT for hot loops (the memory monitor
+        and spill loop poll plain ``stats()``, which stays O(1)).
+
+        Pinned = objects held by zero-copy readers or in-progress
+        writers; they cannot be evicted, so climbing pinned_bytes under
+        store pressure is the first thing to look at (surfaced on the
+        dashboard /metrics). device_staged_bytes is the cumulative
+        device-array bytes DMA-staged into this arena, node-wide."""
+        out = self.stats()
+        pinned_n = ctypes.c_uint64()
+        pinned_b = ctypes.c_uint64()
+        staged = ctypes.c_uint64()
+        self._lib.rtpu_stats_ex(self._handle, ctypes.byref(pinned_n),
+                                ctypes.byref(pinned_b), ctypes.byref(staged))
+        out["pinned_objects"] = pinned_n.value
+        out["pinned_bytes"] = pinned_b.value
+        out["device_staged_bytes"] = staged.value
+        return out
+
     def list_objects(self, max_n: int = 4096) -> list:
         self._check_open()
         buf = (ctypes.c_uint8 * (max_n * ID_SIZE))()
@@ -257,6 +292,7 @@ class PlasmaClient:
             raise
         del buf  # drop the memoryview before any later delete/eviction
         self.seal(object_id)
+        self._charge_staged(sobj)
         return size
 
     def put_serialized(self, object_id: bytes, sobj) -> int:
@@ -267,13 +303,25 @@ class PlasmaClient:
         finally:
             del buf
         self.seal(object_id)
+        self._charge_staged(sobj)
         return size
 
-    # Objects at or above this deserialize zero-copy out of the arena,
-    # pinned until the returned value is garbage collected (reference:
-    # plasma zero-copy numpy reads — arrays are READ-ONLY views). Below
-    # it, copying costs less than pin bookkeeping.
-    ZERO_COPY_MIN = 1 * 1024 * 1024
+    def _charge_staged(self, sobj) -> None:
+        """Charge device-array bytes staged into this object to the
+        arena-wide counter (read back by every client's stats(), ridden
+        by the node manager's heartbeat for staging-bytes accounting)."""
+        n = getattr(sobj, "device_bytes", 0)
+        if n:
+            self._lib.rtpu_add_staged(self._handle, n)
+
+    @property
+    def zero_copy_min(self) -> int:
+        """Objects at or above this deserialize zero-copy out of the
+        arena, pinned until the returned value is garbage collected
+        (reference: plasma zero-copy numpy reads — arrays are READ-ONLY
+        views). Below it, copying costs less than pin bookkeeping.
+        Env-overridable: RAY_TPU_ZERO_COPY_MIN (config registry)."""
+        return int(_config.zero_copy_min)
 
     def get_value(self, object_id: bytes, timeout_ms: int = -1):
         """Deserialize a stored value.
@@ -296,7 +344,7 @@ class PlasmaClient:
         if rc != RTPU_OK:
             raise OSError(f"get failed rc={rc}")
         size = size_c.value
-        if size < self.ZERO_COPY_MIN:
+        if size < self.zero_copy_min:
             view = self._view[off.value:off.value + size]
             try:
                 data = bytes(view)  # copy out; eviction decoupled from GC

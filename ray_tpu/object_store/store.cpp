@@ -81,6 +81,11 @@ struct ArenaHeader {
   // manager owns memory pressure and spills to disk first (reference:
   // spill-before-evict in local_object_manager / create_request_queue).
   uint32_t allow_evict;
+  uint32_t pad2;
+  // Cumulative device-array (jax.Array) bytes DMA-staged into this arena
+  // by any client on the node (plasma.py charges it on seal); the node
+  // manager reads it via rtpu_stats_ex for staging-bytes accounting.
+  uint64_t device_staged_bytes;
 };
 
 struct Handle {
@@ -590,6 +595,36 @@ void rtpu_stats(void* hv, uint64_t* used, uint64_t* capacity,
   *capacity = h->hdr->data_size;
   *num_objects = h->hdr->num_objects;
   *evictions = h->hdr->evictions;
+}
+
+// Pin accounting + staging counter. Pinned = any live entry a client
+// currently holds a reference on (zero-copy readers on sealed objects,
+// writers on unsealed ones): these are exempt from eviction, so their
+// byte total is the store's non-reclaimable floor. O(max_objects) scan
+// under the lock — a stats call, not a hot path.
+void rtpu_stats_ex(void* hv, uint64_t* pinned_objects, uint64_t* pinned_bytes,
+                   uint64_t* device_staged_bytes) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  Locker lock(h->hdr);
+  uint64_t n = 0, bytes = 0;
+  for (uint64_t i = 0; i < h->hdr->max_objects; i++) {
+    Entry* e = &h->entries[i];
+    if (e->state != kEmpty && e->refcount > 0) {
+      n++;
+      bytes += e->size;
+    }
+  }
+  *pinned_objects = n;
+  *pinned_bytes = bytes;
+  *device_staged_bytes = h->hdr->device_staged_bytes;
+}
+
+// Charge device-array bytes staged into the arena (cumulative, node-wide:
+// every client adds here so the node manager sees total staging traffic).
+void rtpu_add_staged(void* hv, uint64_t nbytes) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  Locker lock(h->hdr);
+  h->hdr->device_staged_bytes += nbytes;
 }
 
 // List up to max_n sealed object ids into out (28 bytes each); returns count.

@@ -33,6 +33,10 @@ _TASK_DEFAULTS = dict(
     runtime_env=None,
     max_calls=0,
     _metadata=None,
+    # Opt-in device-object donation: release the producing worker's
+    # jax.Array HBM buffer as soon as the return value is staged into
+    # the object store (see task_spec.TaskSpec.donate_result).
+    _donate_result=False,
 )
 
 
@@ -94,6 +98,7 @@ class RemoteFunction:
             placement_group=pg,
             placement_group_bundle_index=bundle_index,
             runtime_env=o["runtime_env"],
+            donate_result=bool(o["_donate_result"]),
         )
         if o["num_returns"] == 0:
             return None

@@ -1,0 +1,340 @@
+"""Device arrays (jax.Array) as first-class store objects
+(``_private/device_objects.py``).
+
+The bounded-copy contract under test (ISSUE 3 acceptance):
+
+* put/get round-trip preserves dtype/shape/values — including extended
+  ML dtypes (bfloat16) that numpy's ``dtype.str`` cannot spell;
+* put performs NO host materialization beyond the arena slab on CPU
+  backends (asserted via the staging-allocation probe counters) and the
+  staged bytes land on the arena-wide accounting counter;
+* cross-process-style get performs exactly ONE arena-backed
+  ``device_put`` rebuild, and the arena pin (store refcount) holds until
+  the rebuilt array is collected — surviving eviction pressure;
+* same-process get returns the IDENTICAL array object, zero copies;
+* ``_donate_result`` releases the producer's device buffer the moment
+  staging completes;
+* everything runs under ``JAX_PLATFORMS=cpu`` (conftest forces it), and
+  the legacy pickle-via-host path still works with the feature off.
+"""
+
+import gc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import device_objects, serialization
+from ray_tpu._private.config import config
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.object_store import plasma
+
+
+def _oid(i: int) -> bytes:
+    return b"DV" + i.to_bytes(4, "little") + b"\x00" * 22
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = str(tmp_path / "arena")
+    plasma.create_store(path, capacity=64 * 1024 * 1024, max_objects=1024)
+    client = plasma.PlasmaClient(path)
+    yield client
+    client.close()
+
+
+@pytest.fixture
+def ray_1cpu():
+    # num_cpus=1 => a single worker process, so back-to-back same-shape
+    # tasks land on the same leased worker (donation test needs that).
+    ctx = ray_tpu.init(num_cpus=1, object_store_memory=256 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _device_array(n_bytes: int, dtype=jnp.float32):
+    n = n_bytes // np.dtype(dtype).itemsize
+    arr = jnp.arange(n, dtype=dtype)
+    return jax.block_until_ready(arr)
+
+
+# ------------------------------------------------------------- round trip
+
+@pytest.mark.parametrize("dtype", ["float32", "int8", "bfloat16"])
+def test_roundtrip_preserves_dtype_shape_values(store, dtype):
+    arr = jax.block_until_ready(
+        jnp.arange(4096, dtype=dtype).reshape(64, 64))
+    store.put_value(_oid(1), arr)
+    back, ok = store.get_value(_oid(1), timeout_ms=0)
+    assert ok
+    assert isinstance(back, jax.Array)
+    assert back.dtype == arr.dtype
+    assert back.shape == arr.shape
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
+
+
+def test_roundtrip_nested_in_pytree(store):
+    arr = _device_array(2 << 20)
+    value = {"weights": arr, "step": 7, "tag": "ckpt"}
+    store.put_value(_oid(2), value)
+    back, ok = store.get_value(_oid(2), timeout_ms=0)
+    assert ok and back["step"] == 7 and back["tag"] == "ckpt"
+    np.testing.assert_array_equal(np.asarray(back["weights"]),
+                                  np.asarray(arr))
+
+
+def test_frame_is_oob_not_inband(store):
+    # The tensor must ride the out-of-band buffer channel, not the pickle
+    # stream (default jax pickling embeds it in-band — the whole point of
+    # the reducer is to avoid that copy).
+    arr = _device_array(4 << 20)
+    sobj = serialization.serialize(arr)
+    assert len(sobj.metadata) < 64 * 1024
+    assert sum(b.nbytes for b in sobj.buffers) >= arr.nbytes
+    assert sobj.device_bytes == arr.nbytes
+
+
+# ------------------------------------------------- copy-count contract
+
+def test_put_no_host_materialization_and_staging_accounted(store):
+    arr = _device_array(8 << 20)
+    device_objects.reset_stats()
+    staged_before = store.stats_ex()["device_staged_bytes"]
+    store.put_value(_oid(3), arr)
+    s = device_objects.stats()
+    assert s["puts"] == 1
+    # CPU backend: the host view aliases the device buffer, so the ONLY
+    # copy is the write into the arena slab.
+    assert s["host_materializations"] == 0
+    assert s["staged_bytes"] == arr.nbytes
+    assert store.stats_ex()["device_staged_bytes"] - staged_before == arr.nbytes
+
+
+def test_get_exactly_one_rebuild_and_pin_lifecycle(store):
+    arr = _device_array(8 << 20)  # > zero_copy_min => arena-backed view
+    store.put_value(_oid(4), arr)
+    device_objects.reset_stats()
+    back, ok = store.get_value(_oid(4), timeout_ms=0)
+    assert ok
+    assert device_objects.stats()["rebuilds"] == 1
+    # The store slot is pinned while the rebuilt array lives (eviction-
+    # exempt), and released once it is collected.
+    st = store.stats_ex()
+    assert st["pinned_objects"] >= 1 and st["pinned_bytes"] >= arr.nbytes
+    del back
+    gc.collect()
+    st = store.stats_ex()
+    assert st["pinned_objects"] == 0 and st["pinned_bytes"] == 0
+
+
+def test_pin_survives_eviction_pressure(store):
+    arr = _device_array(8 << 20)
+    store.put_value(_oid(5), arr)
+    back, ok = store.get_value(_oid(5), timeout_ms=0)
+    assert ok
+    expect = np.asarray(arr).copy()
+    # Hammer the 64 MiB arena with ~80 MiB of churn: everything unpinned
+    # gets LRU-evicted, the pinned device object must not.
+    for i in range(80):
+        store.put_value(_oid(100 + i), np.ones(1 << 20, np.uint8))
+    assert store.stats()["evictions"] > 0
+    assert store.contains(_oid(5))
+    np.testing.assert_array_equal(np.asarray(back), expect)
+    del back
+    gc.collect()
+    # Consumer dropped the array: the slot is reclaimable again.
+    for i in range(80):
+        store.put_value(_oid(300 + i), np.ones(1 << 20, np.uint8))
+    assert not store.contains(_oid(5))
+
+
+# ------------------------------------------------- same-process handoff
+
+def test_same_process_get_returns_identical_object(ray_1cpu):
+    w = worker_mod.global_worker()
+    arr = _device_array(4 << 20)
+    device_objects.reset_stats()
+    ref = ray_tpu.put(arr)
+    assert ray_tpu.get(ref) is arr
+    assert ray_tpu.get(ref) is arr
+    s = device_objects.stats()
+    assert s["local_hits"] == 2 and s["rebuilds"] == 0
+    # Clearing the registry simulates a different consumer process: the
+    # arena rebuild path kicks in, exactly once per get.
+    w._device_local.clear()
+    back = ray_tpu.get(ref)
+    assert back is not arr
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
+    assert device_objects.stats()["rebuilds"] == 1
+
+
+def test_task_chain_stays_by_reference(ray_1cpu):
+    # An actor/worker chaining steps: the consumer task resolves its arg
+    # from the producer's weak registry when both run in one process.
+    @ray_tpu.remote
+    def make():
+        a = jnp.ones((256, 256), jnp.float32)
+        return jax.block_until_ready(a)
+
+    @ray_tpu.remote
+    def consume(x):
+        assert isinstance(x, jax.Array)
+        return float(x.sum())
+
+    r = make.remote()
+    assert ray_tpu.get(consume.remote(r)) == 256.0 * 256.0
+
+
+# ------------------------------------------------------------ donation
+
+def test_donation_releases_producer_buffer_unit():
+    class _Core:
+        pass
+
+    core = _Core()
+    core._device_local = {}
+    arr = _device_array(1 << 20)
+    device_objects.note_return(core, b"d" * 28, arr, donate=True)
+    assert arr.is_deleted()
+    assert core._device_local == {}  # donated arrays are not registered
+
+    arr2 = _device_array(1 << 20)
+    device_objects.note_return(core, b"e" * 28, arr2, donate=False)
+    assert not arr2.is_deleted()
+    assert core._device_local[b"e" * 28] is arr2
+
+
+def test_donate_result_flag_plumbs_to_task_spec():
+    from ray_tpu.remote_function import RemoteFunction
+
+    rf = RemoteFunction(lambda: None, {"_donate_result": True})
+    assert rf._options["_donate_result"] is True
+    from ray_tpu._private.task_spec import TaskSpec
+
+    assert TaskSpec.__dataclass_fields__["donate_result"].default is False
+
+
+def test_donation_multi_return_same_array(ray_1cpu):
+    # num_returns=2 returning (x, x): donation must be deferred until
+    # BOTH slots are staged — deleting at slot 0 would make slot 1
+    # serialize a dead buffer and fail the task after user code ran.
+    @ray_tpu.remote(num_returns=2, _donate_result=True)
+    def twice():
+        x = jax.block_until_ready(jnp.full(64, 5.0, jnp.float32))
+        return x, x
+
+    r1, r2 = twice.remote()
+    a, b = ray_tpu.get([r1, r2])
+    np.testing.assert_array_equal(np.asarray(a), np.full(64, 5.0,
+                                                         np.float32))
+    np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
+
+
+def test_lookup_local_respects_toggle(ray_1cpu):
+    # The by-reference short-circuit must stand down with the feature
+    # off, or the A/B off-baseline is contaminated by on-path hits.
+    w = worker_mod.global_worker()
+    arr = _device_array(2 << 20)
+    ref = ray_tpu.put(arr)
+    assert ray_tpu.get(ref) is arr
+    config.set("device_objects_enabled", False)
+    try:
+        assert ray_tpu.get(ref) is not arr
+    finally:
+        config.set("device_objects_enabled", True)
+    assert ray_tpu.get(ref) is arr
+
+
+def test_donation_end_to_end(ray_1cpu):
+    # Producer task stages its return, donation deletes its HBM buffer;
+    # a follow-up task in the same worker process observes the deletion.
+    @ray_tpu.remote(_donate_result=True)
+    def produce():
+        import builtins
+
+        a = jax.block_until_ready(jnp.ones((128, 128), jnp.float32))
+        builtins._rtpu_donated_probe = a
+        return a
+
+    @ray_tpu.remote
+    def check():
+        import builtins
+
+        a = getattr(builtins, "_rtpu_donated_probe", None)
+        return None if a is None else a.is_deleted()
+
+    out = ray_tpu.get(produce.remote())
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.ones((128, 128), np.float32))
+    deleted = ray_tpu.get(check.remote())
+    if deleted is None:
+        pytest.skip("follow-up task landed on a different worker process")
+    assert deleted is True
+
+
+# ------------------------------------------------------- CPU fallback / off
+
+def test_off_path_roundtrip(store):
+    # With the feature off the reducer stands down: device arrays take
+    # the legacy pickle-via-host path and still round-trip correctly.
+    config.set("device_objects_enabled", False)
+    try:
+        arr = _device_array(2 << 20)
+        device_objects.reset_stats()
+        store.put_value(_oid(7), arr)
+        assert device_objects.stats()["puts"] == 0
+        back, ok = store.get_value(_oid(7), timeout_ms=0)
+        assert ok
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
+    finally:
+        config.set("device_objects_enabled", True)
+
+
+def test_rebuild_numpy_fallback_matches():
+    # The rebuild callable's jax-less branch: a consumer that cannot
+    # device_put still gets a correct (read-only) numpy view.
+    arr = _device_array(1 << 20)
+    sobj = serialization.serialize(arr)
+    data = sobj.to_bytes()
+    back = serialization.loads_oob(data)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
+
+
+# ------------------------------------------------------- zero_copy_min knob
+
+def test_zero_copy_min_env_override(monkeypatch):
+    from ray_tpu._private.config import Config
+
+    monkeypatch.setenv("RAY_TPU_ZERO_COPY_MIN", "4096")
+    c = Config()
+    c.define("zero_copy_min", 1 << 20, "doc")
+    assert c.get("zero_copy_min") == 4096
+
+
+def test_zero_copy_min_gates_pinning(store):
+    arr = np.arange(1 << 16, dtype=np.float64)  # 512 KiB numpy object
+    store.put_value(_oid(8), arr)
+    old = config.zero_copy_min
+    try:
+        # Above the threshold: copied out, slot NOT pinned after get.
+        config.set("zero_copy_min", 8 << 20)
+        back, _ = store.get_value(_oid(8), timeout_ms=0)
+        assert store.stats_ex()["pinned_objects"] == 0
+        del back
+        # Below the threshold: zero-copy view, slot pinned until GC.
+        config.set("zero_copy_min", 1024)
+        back, _ = store.get_value(_oid(8), timeout_ms=0)
+        assert store.stats_ex()["pinned_objects"] == 1
+        del back
+        gc.collect()
+        assert store.stats_ex()["pinned_objects"] == 0
+    finally:
+        config.set("zero_copy_min", old)
+
+
+def test_stats_expose_pin_and_staging_keys(store):
+    st = store.stats_ex()
+    for key in ("pinned_objects", "pinned_bytes", "device_staged_bytes"):
+        assert key in st and st[key] == 0

@@ -115,6 +115,11 @@ def test_node_hardware_reporter(ray_cluster):
         f"http://127.0.0.1:{port}/metrics", timeout=15).read().decode()
     assert "ray_tpu_node_store_capacity_bytes" in text
     assert "ray_tpu_node_mem_total_bytes" in text
+    # Pin accounting + device staging ride the same heartbeat sample
+    # (store.cpp rtpu_stats_ex -> NM hw -> /metrics gauges).
+    assert "ray_tpu_node_store_pinned_objects" in text
+    assert "ray_tpu_node_store_pinned_bytes" in text
+    assert "ray_tpu_node_device_staged_bytes_total" in text
 
 
 def test_scheduler_counters_in_prometheus(ray_cluster):
