@@ -215,6 +215,47 @@ _d("tpu_worker_idle_timeout_s", 300.0,
    "after this idle time (its chips return to the node free list). "
    "Generous by default: re-spawning pays multi-second XLA client init.")
 
+# --- gang fault tolerance (collective groups / train worker gangs) ----------
+_d("gang_heartbeat_s", 1.0,
+   "Liveness/poison heartbeat for gang-scheduled groups: the WorkerGroup "
+   "supervisor pings each member actor at this period, and every "
+   "collective member polls the group coordinator's poison flag at this "
+   "period (so a pending collective raises GangMemberDiedError within "
+   "~2x this interval of the gang being poisoned, instead of waiting "
+   "out the full collective op timeout). Env: RAY_TPU_GANG_HEARTBEAT_S.")
+_d("gang_ping_miss_limit", 30,
+   "Consecutive missed liveness pings before the gang supervisor "
+   "declares a wedged-but-alive member dead. Deliberately generous "
+   "(30 s at the default heartbeat): a rank whose main thread is "
+   "GIL-starved by a long XLA trace/compile must not be declared dead "
+   "— an actor whose PROCESS died is detected within ~1 heartbeat via "
+   "the GCS actor-failure notification, not this budget, so this only "
+   "bounds truly wedged-alive ranks (vs the old 300 s op deadline).")
+_d("gang_poll_timeout_s", 30.0,
+   "Deadline for one WorkerGroup.poll() round (shared across all "
+   "members; polls are submitted in parallel). A rank whose reply "
+   "misses the round is treated as still running — its in-flight poll "
+   "is re-awaited next round (~100ms later) so no drained report is "
+   "lost — and a dead rank surfaces as state='dead' instead of "
+   "aborting the whole poll batch (the supervisor owns death "
+   "detection).")
+_d("gang_restart_backoff_s", 0.5,
+   "Base of the exponential backoff between gang re-formation attempts "
+   "after a gang-member death (doubles per restart).")
+_d("gang_restart_backoff_max_s", 30.0,
+   "Cap on the gang re-formation backoff.")
+_d("gang_poison_teardown_enabled", True,
+   "On poison, after a grace of 2x the gang heartbeat with a collective "
+   "still in flight, tear down the wedged jax.distributed world so "
+   "survivors blocked inside a compiled step unwedge (the xla_dist "
+   "analog of aborting a NCCL communicator).")
+_d("collective_op_timeout_s", 300.0,
+   "Deadline for one collective operation (was a hardcoded 300 s); "
+   "poisoned groups raise GangMemberDiedError long before this.")
+_d("collective_rendezvous_timeout_s", 60.0,
+   "Deadline for group-formation rendezvous (coordinator actor lookup, "
+   "jax.distributed coordinator address exchange, world join).")
+
 # --- memory monitor ---------------------------------------------------------
 _d("memory_monitor_refresh_ms", 250,
    "Node memory sampling period; 0 disables the monitor "

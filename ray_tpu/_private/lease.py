@@ -120,6 +120,10 @@ class LeaseManager:
         self._worker_timeout = float(config.worker_start_timeout_s) + 10.0
         self._bulk_conn = None   # lazy second GCS conn for fallback waves
         self._closed = False
+        # In-flight local lease requests awaiting the NM's deferred reply
+        # (deadline-bounded by _check_local_waits on the flush loop).
+        self._local_waits: List[dict] = []
+        self._local_waits_lock = threading.Lock()
         # Local-first scheduling: lease requests go to OUR node manager
         # first (one local round trip, no GCS lock); the GCS-brokered
         # path below becomes the spillback. Pre-dial the NM so the hot
@@ -247,11 +251,76 @@ class LeaseManager:
             except BaseException:
                 self._request_gcs_lease(key, t0)
                 return
-            fut.add_done_callback(
-                lambda f: self._exec_submit(
-                    self._on_local_lease_reply, key, t0, f))
+            # Bound the deferred NM reply by the worker-start timeout
+            # (r7 finding a): when the grant's worker hangs during
+            # startup the NM's reply defers forever — after the same
+            # bound the GCS-brokered path applies (``_worker_timeout``),
+            # give up on the local grant and spill back to the GCS so
+            # this shape's pipeline can't wedge. A grant that arrives
+            # late is handed straight back to the NM. The deadline is
+            # enforced by the manager's existing flush loop (one shared
+            # thread), not a per-request Timer thread.
+            #
+            # The settled flag gets its OWN lock: on_reply can run
+            # inline on THIS thread (future already done inside
+            # add_done_callback) while the caller holds self._lock —
+            # taking self._lock here would self-deadlock the manager.
+            wait = {"settled": False, "lock": threading.Lock(),
+                    "deadline": time.monotonic() + self._worker_timeout,
+                    "key": key, "t0": t0}
+
+            def on_reply(f, wait=wait, key=key, t0=t0):
+                with wait["lock"]:
+                    late = wait["settled"]
+                    wait["settled"] = True
+                if not late:
+                    self._exec_submit(self._on_local_lease_reply,
+                                      key, t0, f)
+                    return
+                try:
+                    grant = f.result(0)
+                except BaseException:
+                    return
+                if grant is not None:
+                    # Hand the late grant straight back (off the serve
+                    # thread — the NM dial may block).
+                    def give_back(grant=grant):
+                        try:
+                            self._w.nm_conn(self._local_nm_addr).notify(
+                                protocol.RETURN_LOCAL_LEASE,
+                                {"lease_id": grant["lease_id"],
+                                 "worker_id": grant.get("worker_id")})
+                        except Exception:
+                            pass
+
+                    self._exec_submit(give_back)
+
+            with self._local_waits_lock:
+                self._local_waits.append(wait)
+            fut.add_done_callback(on_reply)
             return
         self._request_gcs_lease(key, t0)
+
+    def _check_local_waits(self):
+        """Fire worker-start-timeout spillbacks for local lease requests
+        whose deferred NM reply never arrived (runs on the flush loop;
+        settled entries are dropped on scan)."""
+        now = time.monotonic()
+        fire = []
+        with self._local_waits_lock:
+            keep = []
+            for wait in self._local_waits:
+                if wait["settled"]:
+                    continue
+                (fire if now >= wait["deadline"] else keep).append(wait)
+            self._local_waits = keep
+        for wait in fire:
+            with wait["lock"]:
+                if wait["settled"]:
+                    continue
+                wait["settled"] = True
+            self._exec_submit(self._request_gcs_lease,
+                              wait["key"], wait["t0"])
 
     def _request_gcs_lease(self, key: tuple, t0: float):
         st = self._shapes.get(key)
@@ -722,6 +791,7 @@ class LeaseManager:
                 self._flush_reports()
                 self._reap_idle()
                 self._retry_backlogged()
+                self._check_local_waits()
             except Exception:
                 pass
 

@@ -664,14 +664,36 @@ class NodeManager:
 
     def _reap_loop(self):
         """Detect dead worker processes even if their socket lingers;
-        retire chip-bound workers parked past their idle timeout."""
+        retire chip-bound workers parked past their idle timeout; bound
+        how long a deferred lease reply can wait on a worker that hangs
+        during startup (alive, never registers) — past the worker-start
+        timeout the worker is killed, which errors the deferred reply so
+        the lease caller falls back to the GCS-brokered path instead of
+        wedging that shape's pipeline (r7 finding a)."""
         tpu_idle_timeout = float(config.tpu_worker_idle_timeout_s)
         while not self._shutdown:
             time.sleep(0.2)
+            start_timeout = float(config.worker_start_timeout_s)
+            hung: List[WorkerHandle] = []
             with self._lock:
                 dead = [w for w in self._workers.values()
                         if w.proc.poll() is not None and w.state != "dead"]
                 now = time.time()
+                for w in self._workers.values():
+                    if (w.state == STARTING and w.lease_reply is not None
+                            and w.busy_since
+                            and now - w.busy_since > start_timeout
+                            and w.proc.poll() is None):
+                        # Kill under the lock: registration (serve
+                        # thread) also runs under it, so a worker that
+                        # registers at the timeout boundary can't be
+                        # killed after its grant was already handed out.
+                        w.killed_by_us = True
+                        try:
+                            w.proc.kill()
+                        except Exception:
+                            pass
+                        hung.append(w)
                 expired: List[WorkerHandle] = []
                 for key, pool in list(self._tpu_idle.items()):
                     keep = []
@@ -687,8 +709,18 @@ class NodeManager:
                         self._tpu_idle[key] = keep
                     else:
                         self._tpu_idle.pop(key, None)
+            for w in hung:
+                logger.warning(
+                    "worker %s hung during startup for a pending lease "
+                    "(> %.0fs); killed it so the caller falls back",
+                    w.worker_id.hex()[:12], start_timeout)
             for w in dead:
-                self._on_worker_death(w)
+                try:
+                    self._on_worker_death(w)
+                except Exception:
+                    # The reap thread is the node's death detector: one
+                    # handler failure must not terminate it.
+                    logger.exception("worker death handling failed")
             for w in expired:
                 try:
                     w.conn.notify("exit")
@@ -955,10 +987,16 @@ class NodeManager:
                 and not w.dedicated:
             # keep the pool full
             with self._lock:
-                n = len([x for x in self._workers.values()
-                         if not x.dedicated])
-                if n < self._max_pool:
+                refill = len([x for x in self._workers.values()
+                              if not x.dedicated]) < self._max_pool
+            if refill:
+                try:
                     self._spawn_worker()
+                except BaseException:
+                    # A transient fork failure (likely under the same
+                    # pressure that killed the worker) must not unwind
+                    # into the reaper and disable death detection.
+                    logger.exception("pool refill spawn failed")
         self._dispatch_queued()
 
     def _store_errors(self, object_ids: List[bytes], err: BaseException):
@@ -1091,8 +1129,23 @@ class NodeManager:
                 with self._lock:
                     self._task_queue.append(spec)
                 return
-            w = self._spawn_worker(dedicated=True, env_extra=env,
-                                   tpu_chips=chips)
+            try:
+                w = self._spawn_worker(dedicated=True, env_extra=env,
+                                       tpu_chips=chips)
+            except BaseException as e:
+                # Spawn failed AFTER the ledger hold and chip acquisition:
+                # release both (the task never binds to a WorkerHandle, so
+                # no death/done path will) and fail the task through the
+                # normal report — repeated spawn failures must not
+                # permanently shrink local capacity (r7 finding c; the
+                # attached[] guard pattern from _on_lease_worker).
+                with self._lock:
+                    for c in chips:
+                        self._free_tpu_chips.add(c)
+                self._report_task_done(
+                    tid, "crashed", [],
+                    error=f"worker spawn failed: {e}")
+                return
             with self._lock:
                 w.pending_pushes.append(("run_task", spec))
                 w.current_tasks[spec.task_id.binary()] = spec
@@ -1100,11 +1153,21 @@ class NodeManager:
         with self._lock:
             w = self._pop_idle_locked()
             if w is None:
-                n = len([x for x in self._workers.values() if not x.dedicated])
-                if n < self._max_pool + 2:
-                    self._spawn_worker()
+                # Queue FIRST: a pool-refill spawn failure must leave the
+                # spec queued (retried on the next dispatch trigger) with
+                # its ledger hold intact, not leak the hold by unwinding
+                # out of this handler (r7 finding c).
                 self._task_queue.append(spec)
-                return
+                n = len([x for x in self._workers.values() if not x.dedicated])
+                refill = n < self._max_pool + 2
+        if w is None:
+            if refill:
+                try:
+                    self._spawn_worker()
+                except BaseException:
+                    logger.exception("pool refill spawn failed; task "
+                                     "stays queued")
+            return
         self._push_task(w, spec)
 
     def _materialize_runtime_env(self, runtime_env):
@@ -1153,9 +1216,20 @@ class NodeManager:
                 return
         env = dict(plugin_env)
         env.update((spec.runtime_env or {}).get("env_vars", {}))
-        w = self._spawn_worker(dedicated=True, env_extra=env, cwd=cwd,
-                               extra_pythonpath=pypaths,
-                               tpu_chips=chips or None)
+        try:
+            w = self._spawn_worker(dedicated=True, env_extra=env, cwd=cwd,
+                                   extra_pythonpath=pypaths,
+                                   tpu_chips=chips or None)
+        except BaseException as e:
+            # Release the ledger hold + chips (nothing will ever bind
+            # them) and fail the task cleanly (r7 finding c).
+            with self._lock:
+                for c in chips:
+                    self._free_tpu_chips.add(c)
+            self._report_task_done(
+                spec.task_id.binary(), "crashed", [],
+                error=f"worker spawn failed: {e}")
+            return
         with self._lock:
             w.isolated = True
             w.pending_pushes.append(("run_task", spec))
@@ -1353,7 +1427,10 @@ class NodeManager:
                     self._on_worker_death(w)
                     return
                 if refill:
-                    self._spawn_worker()
+                    try:
+                        self._spawn_worker()
+                    except BaseException:
+                        logger.exception("pool refill spawn failed")
                 return
         if k > 0:
             chips = self._acquire_chips(k)
@@ -1365,9 +1442,27 @@ class NodeManager:
                     "creation_failed": True,
                     "error": "TPU chips unavailable"})
                 return
-        w = self._spawn_worker(dedicated=True, env_extra=env,
-                               tpu_chips=chips, cwd=cwd,
-                               extra_pythonpath=pypaths)
+        try:
+            w = self._spawn_worker(dedicated=True, env_extra=env,
+                                   tpu_chips=chips, cwd=cwd,
+                                   extra_pythonpath=pypaths)
+        except BaseException as e:
+            # Spawn failed after the ledger hold (and possibly chips) were
+            # acquired: release them — only a WorkerHandle-bound hold has
+            # a death path to release it (r7 finding c) — and report the
+            # creation failure so the GCS can retry elsewhere.
+            with self._lock:
+                for c in chips:
+                    self._free_tpu_chips.add(c)
+            self._release_actor_hold(aid_b)
+            try:
+                self.gcs.notify("actor_state", {
+                    "actor_id": spec.actor_id.binary(), "state": "DEAD",
+                    "creation_failed": True,
+                    "error": f"worker spawn failed: {e}"})
+            except Exception:
+                pass
+            return
         with self._lock:
             if cwd is not None or pypaths:
                 w.isolated = True
@@ -1568,22 +1663,38 @@ class NodeManager:
             if w is None:
                 conn.reply_error(msg_id, "unknown worker")
                 return
-            w.conn = conn
-            w.direct_address = p.get("direct_address")
-            w.direct_address_ux = p.get("direct_address_ux")
-            conn.meta["worker_id"] = wid
-            pushes, w.pending_pushes = w.pending_pushes, []
-            if w.state == STARTING:
-                if w.lease_reply is not None:
-                    # Spawned to satisfy a pending lease: hand it to the
-                    # waiting caller now that its direct address is known.
-                    lease_reply, w.lease_reply = w.lease_reply, None
-                    w.state = LEASED
-                elif w.dedicated:
-                    w.state = BUSY
-                else:
-                    w.state = IDLE
-                    self._idle.append(w)
+            if w.killed_by_us or w.proc.poll() is not None:
+                # Raced the reaper (e.g. the hung-startup kill, which
+                # also runs under this lock): the process is dead or
+                # dying — never transition it to IDLE/LEASED or hand it
+                # to a lease caller; the death path owns cleanup
+                # (including erroring any parked lease_reply).
+                reject = True
+            else:
+                reject = False
+                w.conn = conn
+                w.direct_address = p.get("direct_address")
+                w.direct_address_ux = p.get("direct_address_ux")
+                conn.meta["worker_id"] = wid
+                pushes, w.pending_pushes = w.pending_pushes, []
+                if w.state == STARTING:
+                    if w.lease_reply is not None:
+                        # Spawned to satisfy a pending lease: hand it to
+                        # the waiting caller now that its direct address
+                        # is known.
+                        lease_reply, w.lease_reply = w.lease_reply, None
+                        w.state = LEASED
+                    elif w.dedicated:
+                        w.state = BUSY
+                    else:
+                        w.state = IDLE
+                        self._idle.append(w)
+        if reject:
+            try:
+                conn.reply_error(msg_id, "worker was reaped at startup")
+            except protocol.ConnectionClosed:
+                pass
+            return
         conn.reply(msg_id, {"node_id": self.node_id})
         if lease_reply is not None:
             lconn, lmsg_id = lease_reply

@@ -142,16 +142,28 @@ class _DaemonPool:
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._threads = 0
         self._idle = 0
+        self._backlog = 0      # submitted, not yet claimed by a thread
         self._lock = threading.Lock()
 
     def submit(self, fn) -> None:
-        self._q.put(fn)
+        # Check-and-reserve is atomic under the pool lock: the backlog
+        # counter covers THIS submission, so two concurrent submits that
+        # both observe one idle thread can't both skip the spawn (the
+        # second sees backlog 2 > idle 1 and spawns). Over-spawning is
+        # bounded by _max and harmless; under-spawning strands a waiter
+        # behind an unrelated long-running resolution.
         with self._lock:
-            if self._idle == 0 and self._threads < self._max:
+            self._backlog += 1
+            spawn = (self._idle < self._backlog
+                     and self._threads < self._max)
+            if spawn:
                 self._threads += 1
-                threading.Thread(
-                    target=self._run, daemon=True,
-                    name=f"{self._name}-{self._threads}").start()
+                n = self._threads
+        self._q.put(fn)
+        if spawn:
+            threading.Thread(
+                target=self._run, daemon=True,
+                name=f"{self._name}-{n}").start()
 
     def _run(self):
         while True:
@@ -162,6 +174,7 @@ class _DaemonPool:
             finally:
                 with self._lock:
                     self._idle -= 1
+                    self._backlog -= 1
             try:
                 fn()
             except BaseException:

@@ -97,6 +97,30 @@ class NodeDiedError(RayTpuError):
     pass
 
 
+class GangMemberDiedError(RayTpuError):
+    """A member of a gang-scheduled group (collective group / training
+    worker gang) died, poisoning the whole group.
+
+    On TPU pods the gang is the failure domain: one dead host invalidates
+    the entire mesh, so survivors blocked in a collective must unwedge
+    promptly (the group coordinator's poison flag bounds the raise to the
+    configured gang heartbeat) and the trainer re-forms the gang from the
+    latest checkpoint. ``rank`` is the dead member's rank when known.
+    """
+
+    def __init__(self, message: str = "", *, group_name: str = "",
+                 rank: Optional[int] = None, reason: str = ""):
+        self.group_name = group_name
+        self.rank = rank
+        self.reason = reason
+        if not message:
+            who = f"rank {rank}" if rank is not None else "a member"
+            message = (f"gang member died: {who} of group "
+                       f"'{group_name or 'unknown'}'"
+                       + (f" ({reason})" if reason else ""))
+        super().__init__(message)
+
+
 class PlacementGroupSchedulingError(RayTpuError):
     """The placement group could not be scheduled with current resources."""
 

@@ -245,7 +245,9 @@ def _attention(q, k, v, cfg: GPTConfig, mesh: Optional[Mesh],
     if (cfg.ring_attention and mesh is not None and sp_axis
             and sp_axis in mesh.axis_names and mesh.shape[sp_axis] > 1):
         spec = rules.sharding(mesh, "batch", "seq", "heads", None).spec
-        fn = jax.shard_map(
+        from ray_tpu.parallel.collective import shard_map_compat
+
+        fn = shard_map_compat(
             functools.partial(ring_attention, axis_name=sp_axis, causal=True),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)

@@ -123,7 +123,9 @@ def ring_attention(
     jax.jit, static_argnames=("axis_name", "causal", "mesh"))
 def _ring_attention_jit(q, k, v, mesh, axis_name, causal):
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    from ray_tpu.parallel.collective import shard_map_compat
+
+    fn = shard_map_compat(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -54,6 +54,33 @@ _groups_lock = threading.Lock()
 DEFAULT_GROUP_NAME = "default"
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """jax.shard_map across jax versions: new jax exposes it at top level
+    (``check_vma``); older jax has ``jax.experimental.shard_map`` with the
+    replication check spelled ``check_rep``."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        except TypeError:
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as esm
+
+    try:
+        return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+    except TypeError:
+        return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+# Public alias for the other shard_map users (ring attention, pipeline
+# parallelism, the dry-run entry).
+shard_map_compat = _shard_map
+
+
 class BaseGroup:
     """Interface every collective backend implements."""
 
@@ -158,7 +185,7 @@ class XlaGroup(BaseGroup):
                 g = lax.all_gather(s, "ranks", axis=0, tiled=True)
                 return jnp.prod(g, axis=0, keepdims=True)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 body, mesh=self.mesh, in_specs=P("ranks"),
                 out_specs=P("ranks")))
 
@@ -177,7 +204,7 @@ class XlaGroup(BaseGroup):
             def body(s):
                 return lax.all_gather(s, "ranks", axis=0, tiled=True)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 body, mesh=self.mesh, in_specs=P("ranks"), out_specs=P(),
                 check_vma=False))
 
@@ -204,7 +231,7 @@ class XlaGroup(BaseGroup):
                     r = r / self.world_size
                 return r[None]
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 body, mesh=self.mesh, in_specs=P("ranks"),
                 out_specs=P("ranks")))
 
@@ -226,7 +253,7 @@ class XlaGroup(BaseGroup):
                 g = lax.all_gather(s, "ranks", axis=0, tiled=True)
                 return g[src_rank][None]
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 body, mesh=self.mesh, in_specs=P("ranks"),
                 out_specs=P("ranks")))
 
@@ -246,7 +273,7 @@ class XlaGroup(BaseGroup):
             def body(s):
                 return lax.ppermute(s, "ranks", perm=perm)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 body, mesh=self.mesh, in_specs=P("ranks"),
                 out_specs=P("ranks")))
 
@@ -289,6 +316,21 @@ class _Coordinator:
             collections.OrderedDict()
         self._delivered: "collections.OrderedDict" = \
             collections.OrderedDict()
+        # Gang poisoning: once set (by the gang supervisor on member
+        # death, or by any member that noticed a peer die), every
+        # member's poison watcher sees it within one heartbeat and
+        # pending collectives raise GangMemberDiedError instead of
+        # waiting out the full op deadline.
+        self._poison: Optional[str] = None
+
+    def poison(self, reason: str) -> bool:
+        """Mark the whole group dead. Idempotent; first reason wins."""
+        if self._poison is None:
+            self._poison = str(reason) or "gang poisoned"
+        return True
+
+    def poison_status(self) -> Optional[str]:
+        return self._poison
 
     @staticmethod
     def _cache_put(cache, key, value, cap):
@@ -336,12 +378,27 @@ class StoreGroup(BaseGroup):
     def __init__(self, world_size: int, rank: int, group_name: str):
         super().__init__(world_size, rank, group_name)
         import ray_tpu
+        from ray_tpu._private.config import config
 
         self._seq = 0
         # p2p sequence numbers are per (src, dst) channel — sender and
         # receiver each count that channel's ops, so unrelated ops on either
         # endpoint can't desync the rendezvous keys.
         self._p2p_seq: Dict[tuple, int] = {}
+        self._op_timeout_s = float(config.collective_op_timeout_s)
+        self._rendezvous_timeout_s = float(
+            config.collective_rendezvous_timeout_s)
+        self._heartbeat_s = max(0.05, float(config.gang_heartbeat_s))
+        # Poison state: set by the watcher thread (polling the
+        # coordinator's flag every heartbeat) or locally when a peer/
+        # coordinator failure is observed; every pending op checks it at
+        # heartbeat granularity and raises GangMemberDiedError.
+        self._poisoned: Optional[str] = None
+        self._destroyed = threading.Event()
+        # Initialized BEFORE the watcher starts: _on_poisoned_wedged
+        # (xla_dist override) reads it, and poison can land while the
+        # subclass is still mid-formation.
+        self._op_inflight_since: Optional[float] = None
         name = _COORD_NAME_FMT.format(group_name)
         if rank == 0:
             coord_cls = ray_tpu.remote(_Coordinator)
@@ -351,7 +408,7 @@ class StoreGroup(BaseGroup):
             except Exception:
                 self._coord = ray_tpu.get_actor(name)
         else:
-            deadline = time.time() + 60.0
+            deadline = time.time() + self._rendezvous_timeout_s
             while True:
                 try:
                     self._coord = ray_tpu.get_actor(name)
@@ -362,6 +419,64 @@ class StoreGroup(BaseGroup):
                             f"collective group '{group_name}' rendezvous "
                             f"timed out waiting for rank 0")
                     time.sleep(0.05)
+        if world_size > 1:
+            self._watcher = threading.Thread(
+                target=self._poison_watch_loop, daemon=True,
+                name=f"rtpu-gang-watch-{group_name}")
+            self._watcher.start()
+
+    # ------------------------------------------------------ gang poisoning
+
+    def _check_poison(self):
+        if self._poisoned is not None:
+            from ray_tpu import exceptions
+
+            raise exceptions.GangMemberDiedError(
+                group_name=self.group_name, reason=self._poisoned)
+
+    def _mark_poisoned(self, reason: str):
+        if self._poisoned is None:
+            self._poisoned = reason
+
+    def poisoned(self) -> Optional[str]:
+        return self._poisoned
+
+    def _on_poisoned_wedged(self):
+        """Hook: backend-specific unwedge once poison is observed while an
+        op is still in flight (xla_dist tears down the jax world)."""
+
+    def _poison_watch_loop(self):
+        """Poll the coordinator's poison flag every gang heartbeat.
+
+        The watcher is what bounds time-to-raise for a member wedged in a
+        pending op: the op loops check ``self._poisoned`` at heartbeat
+        granularity, so poison-to-GangMemberDiedError is at most ~2x the
+        heartbeat. A dead coordinator (its node died with the gang member)
+        counts as poison too.
+        """
+        import ray_tpu
+        from ray_tpu import exceptions
+
+        while not self._destroyed.wait(self._heartbeat_s):
+            if self._poisoned is not None:
+                break
+            try:
+                reason = ray_tpu.get(self._coord.poison_status.remote(),
+                                     timeout=2 * self._heartbeat_s)
+            except exceptions.GetTimeoutError:
+                continue
+            except BaseException as e:
+                self._mark_poisoned(
+                    f"collective coordinator unreachable: {e}")
+                break
+            if reason is not None:
+                self._mark_poisoned(reason)
+                break
+        if self._poisoned is not None and not self._destroyed.is_set():
+            try:
+                self._on_poisoned_wedged()
+            except Exception:
+                pass
 
     # Every coordinator round-trip is bounded and retried: a single lost
     # RPC (e.g. a submission dropped in an ack/re-park race) must degrade
@@ -373,30 +488,43 @@ class StoreGroup(BaseGroup):
         import ray_tpu
         from ray_tpu import exceptions
 
+        # Wait in heartbeat-bounded windows so a poisoned group raises
+        # within ~one heartbeat even while blocked on a coordinator RPC.
+        window = min(self._POLL_RPC_TIMEOUT_S, self._heartbeat_s)
+        stale_limit = max(1, int(3 * self._POLL_RPC_TIMEOUT_S / window))
+        self._check_poison()
         ref = fut_factory()
         stale = 0
         while True:
+            self._check_poison()
             left = deadline - time.time()
             if left <= 0:
                 raise TimeoutError(f"collective op {tag} timed out")
             try:
-                return ray_tpu.get(
-                    ref, timeout=min(self._POLL_RPC_TIMEOUT_S, left))
+                return ray_tpu.get(ref, timeout=min(window, left))
             except exceptions.GetTimeoutError:
                 # Keep waiting on the SAME call first; after a few windows
                 # assume the submission was lost and resubmit — safe
                 # because every coordinator op is idempotent (retried
                 # collect/take return cached results).
                 stale += 1
-                if stale >= 3:
+                if stale >= stale_limit:
                     stale = 0
                     ref = fut_factory()
                 continue
+            except exceptions.RayActorError as e:
+                # Coordinator actor died: its node went down with a gang
+                # member (or the group was torn down) — poison locally so
+                # every pending op on this member unwedges.
+                self._mark_poisoned(f"collective coordinator died: {e}")
+                raise exceptions.GangMemberDiedError(
+                    group_name=self.group_name,
+                    reason=self._poisoned) from e
 
     def _exchange(self, tag: str, value) -> List[Any]:
         self._seq += 1
         key = f"{tag}:{self._seq}"
-        deadline = time.time() + 300.0
+        deadline = time.time() + self._op_timeout_s
         self._coord_call(
             lambda: self._coord.contribute.remote(key, self.rank, value),
             deadline, tag)
@@ -458,14 +586,14 @@ class StoreGroup(BaseGroup):
         payload = np.asarray(tensor)
         self._coord_call(
             lambda: self._coord.post.remote(key, payload),
-            time.time() + 300.0, "send")
+            time.time() + self._op_timeout_s, "send")
 
     def recv(self, shape, dtype, src_rank: int):
         chan = (src_rank, self.rank)
         seq = self._p2p_seq.get(chan, 0) + 1
         self._p2p_seq[chan] = seq
         key = f"p2p:{src_rank}->{self.rank}:{seq}"
-        deadline = time.time() + 300.0
+        deadline = time.time() + self._op_timeout_s
         while True:
             val = self._coord_call(
                 lambda: self._coord.take.remote(key), deadline, "recv")
@@ -478,6 +606,7 @@ class StoreGroup(BaseGroup):
     def destroy(self):
         import ray_tpu
 
+        self._destroyed.set()
         if self.rank == 0:
             try:
                 ray_tpu.kill(self._coord)
@@ -513,7 +642,7 @@ def _free_port() -> int:
 
 
 def join_world(coordinator_address: str, world_size: int, rank: int,
-               timeout_s: float = 120.0):
+               timeout_s: Optional[float] = None):
     """Join (or confirm membership in) the process-spanning jax.distributed
     world. Idempotent per process. Returns the 1-D one-device-per-process
     mesh for collective programs.
@@ -526,6 +655,10 @@ def join_world(coordinator_address: str, world_size: int, rank: int,
     """
     import jax
 
+    from ray_tpu._private.config import config as _config
+
+    if timeout_s is None:
+        timeout_s = 2.0 * float(_config.collective_rendezvous_timeout_s)
     # Probe prior initialization WITHOUT touching jax.process_count():
     # that call would itself initialize the (single-process) backend and
     # make jax.distributed.initialize impossible.
@@ -533,6 +666,15 @@ def join_world(coordinator_address: str, world_size: int, rank: int,
 
     already_joined = _jax_distributed.global_state.client is not None
     if not already_joined and world_size > 1:
+        try:
+            # On the CPU test platform cross-process collectives need the
+            # gloo implementation (newer jax defaults to it; jax 0.4.x
+            # defaults to 'none', whose compiled collectives refuse
+            # multi-process meshes). Must be set before the backend
+            # client exists; a no-op on TPU.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=world_size,
@@ -574,24 +716,36 @@ class XlaDistributedGroup(StoreGroup):
         super().__init__(world_size, rank, group_name)
         import ray_tpu
 
-        addr_key = f"jaxdist_addr:{group_name}"
-        if rank == 0:
-            addr = f"{_node_ip()}:{_free_port()}"
-            ray_tpu.get(self._coord.post.remote(addr_key, addr))
-        else:
-            deadline = time.time() + 60.0
-            while True:
-                addr = ray_tpu.get(self._coord.take.remote(addr_key))
-                if addr is not None:
-                    # Re-post for the remaining ranks.
-                    ray_tpu.get(self._coord.post.remote(addr_key, addr))
-                    break
-                if time.time() > deadline:
-                    raise TimeoutError(
-                        f"group '{group_name}': no coordinator address "
-                        f"from rank 0")
-                time.sleep(0.02)
-        self.mesh = join_world(addr, world_size, rank)
+        try:
+            addr_key = f"jaxdist_addr:{group_name}"
+            rdv_deadline = time.time() + self._rendezvous_timeout_s
+            if rank == 0:
+                addr = f"{_node_ip()}:{_free_port()}"
+                ray_tpu.get(self._coord.post.remote(addr_key, addr),
+                            timeout=self._rendezvous_timeout_s)
+            else:
+                while True:
+                    addr = ray_tpu.get(self._coord.take.remote(addr_key),
+                                       timeout=self._rendezvous_timeout_s)
+                    if addr is not None:
+                        # Re-post for the remaining ranks.
+                        ray_tpu.get(
+                            self._coord.post.remote(addr_key, addr),
+                            timeout=self._rendezvous_timeout_s)
+                        break
+                    if time.time() > rdv_deadline:
+                        raise TimeoutError(
+                            f"group '{group_name}': no coordinator "
+                            f"address from rank 0")
+                    time.sleep(0.02)
+            self.mesh = join_world(addr, world_size, rank)
+        except BaseException:
+            # Failed formation: stop the poison watcher StoreGroup
+            # already started, or the abandoned half-built group keeps
+            # polling the coordinator forever (one thread + 1 RPC/s per
+            # formation retry).
+            self._destroyed.set()
+            raise
         self._local_device = self.mesh.devices.flat[rank]
         self._cache: Dict[Any, Any] = {}
 
@@ -615,23 +769,81 @@ class XlaDistributedGroup(StoreGroup):
             self._cache[key] = fn
         return fn
 
+    # Substrings that identify a failed cross-process collective as a
+    # transport/member failure (vs an application error): gloo pair
+    # resets, XLA distributed-runtime heartbeats, coordination-service
+    # barriers. These errors mean a peer process is gone — the gang is
+    # the failure domain, so they surface as GangMemberDiedError.
+    _PEER_FAILURE_MARKERS = (
+        "gloo", "connection reset", "connection refused", "broken pipe",
+        "peer", "heartbeat", "coordination", "distributed runtime",
+        "preempted",
+    )
+
     def _run(self, op_name: str, x, body, out_specs=None):
         import jax
         import numpy as np_
         from jax.sharding import PartitionSpec as P
 
+        self._check_poison()
         x = np_.asarray(x)
         g = self._global(x)
         key = (op_name, x.shape, str(x.dtype))
 
         def build():
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 body, mesh=self.mesh, in_specs=P("ranks"),
                 out_specs=out_specs if out_specs is not None else P("ranks"),
                 check_vma=False))
 
-        out = self._compiled(key, build)(g)
-        return np_.asarray(out.addressable_data(0))
+        self._op_inflight_since = time.time()
+        try:
+            out = self._compiled(key, build)(g)
+            host = np_.asarray(out.addressable_data(0))
+        except BaseException as e:
+            from ray_tpu import exceptions
+
+            msg = str(e).lower()
+            if self._poisoned is not None or any(
+                    m in msg for m in self._PEER_FAILURE_MARKERS):
+                reason = self._poisoned or f"collective transport failed: {e}"
+                self._mark_poisoned(reason)
+                raise exceptions.GangMemberDiedError(
+                    group_name=self.group_name, reason=reason) from e
+            raise
+        finally:
+            self._op_inflight_since = None
+        self._check_poison()
+        return host
+
+    def _on_poisoned_wedged(self):
+        """Poison observed: if a compiled collective is still wedged past a
+        grace of 2x the heartbeat (the dead peer will never enter it), tear
+        down the jax.distributed world so the blocked program errors out —
+        the xla_dist analog of aborting a NCCL communicator. On gloo the
+        transport usually errors by itself first, so this is the TPU-shaped
+        backstop."""
+        from ray_tpu._private.config import config
+
+        if not bool(config.gang_poison_teardown_enabled):
+            return
+        grace = 2.0 * self._heartbeat_s
+        deadline = time.time() + grace
+        while time.time() < deadline:
+            if self._op_inflight_since is None:
+                return   # unwedged on its own (transport error surfaced)
+            if self._destroyed.wait(self._heartbeat_s / 4):
+                return
+        if self._op_inflight_since is None:
+            return
+        try:
+            from jax._src import distributed as _jax_distributed
+
+            client = _jax_distributed.global_state.client
+            if client is not None:
+                client.shutdown()
+        except Exception:
+            pass
 
     # -- collectives (single tensor in / single tensor out, like StoreGroup)
 
@@ -745,6 +957,24 @@ def get_group(group_name: str = DEFAULT_GROUP_NAME) -> BaseGroup:
         raise RuntimeError(
             f"collective group '{group_name}' is not initialized")
     return g
+
+
+def poison_group(group_name: str, reason: str,
+                 timeout_s: float = 10.0) -> bool:
+    """Poison a collective group from ANY process that can reach its
+    coordinator (typically the trainer/driver supervising the gang): every
+    member's poison watcher observes the flag within one gang heartbeat
+    and pending collectives raise GangMemberDiedError. Returns False when
+    the coordinator is unreachable (its node died — members detect that
+    by themselves through their watchers)."""
+    import ray_tpu
+
+    try:
+        coord = ray_tpu.get_actor(_COORD_NAME_FMT.format(group_name))
+        ray_tpu.get(coord.poison.remote(reason), timeout=timeout_s)
+        return True
+    except Exception:
+        return False
 
 
 def destroy_collective_group(group_name: str = DEFAULT_GROUP_NAME):

@@ -95,7 +95,9 @@ def pipeline_apply(
         # Default: shard every param leaf's leading (layers) dim over pp.
         pspec = jax.tree.map(lambda _: P(axis), stage_params)
 
-    fn = jax.shard_map(
+    from ray_tpu.parallel.collective import shard_map_compat
+
+    fn = shard_map_compat(
         body, mesh=mesh,
         in_specs=(pspec, data_spec),
         out_specs=data_spec,

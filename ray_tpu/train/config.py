@@ -80,6 +80,10 @@ class Result:
     error: Optional[BaseException] = None
     metrics_history: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list)
+    # Gang fault tolerance: how many times the worker gang was torn down
+    # and re-formed (from the latest checkpoint) during this run, and why.
+    num_restarts: int = 0
+    restart_reasons: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:

@@ -11,17 +11,22 @@ host invalidates the whole mesh; SURVEY.md §7 hard part (c)).
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import time
 import uuid
 from typing import Any, Callable, Dict, Optional
 
+from ray_tpu.exceptions import GangMemberDiedError
+from ray_tpu._private.config import config
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import (
     Result, RunConfig, ScalingConfig,
 )
-from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.train.worker_group import WorkerGroup, _metrics
+
+logger = logging.getLogger("ray_tpu.train")
 
 _POLL_PERIOD_S = 0.1
 
@@ -61,46 +66,119 @@ class DataParallelTrainer:
         last_error: Optional[BaseException] = None
         history = []
         ckpt_index = 0
+        num_restarts = 0
+        restart_reasons = []
+        backoff = float(config.gang_restart_backoff_s)
+        backoff_max = float(config.gang_restart_backoff_max_s)
 
         while attempts_left > 0:
             attempts_left -= 1
             existing_pg = getattr(self, "_existing_pg", None)
-            group = WorkerGroup(
-                self.scaling_config.num_workers,
-                self.scaling_config.worker_resources(),
-                placement_strategy=self.scaling_config.placement_strategy,
-                backend=self._backend,
-                group_name=f"train_{name}_{uuid.uuid4().hex[:6]}",
-                experiment_name=name,
-                runtime_env=self.scaling_config.worker_runtime_env,
-                existing_pg=existing_pg,
-                bundle_offset=1 if existing_pg is not None else 0)
+            # Every attempt re-forms the gang from scratch: fresh actors,
+            # fresh collective group name (a poisoned coordinator or a
+            # half-dead jax.distributed world can never leak into the next
+            # attempt), and — when the gang owns its placement group — a
+            # fresh PG reservation, so a dead node's bundles are re-placed
+            # on surviving nodes.
+            group = None
+            gang_death = False
+            error = None
+            interrupted = False
+            progress = {"ckpt": latest_ckpt, "idx": ckpt_index}
             try:
+                group = WorkerGroup(
+                    self.scaling_config.num_workers,
+                    self.scaling_config.worker_resources(),
+                    placement_strategy=(
+                        self.scaling_config.placement_strategy),
+                    backend=self._backend,
+                    group_name=f"train_{name}_{uuid.uuid4().hex[:6]}",
+                    experiment_name=name,
+                    runtime_env=self.scaling_config.worker_runtime_env,
+                    existing_pg=existing_pg,
+                    bundle_offset=1 if existing_pg is not None else 0)
                 group.start(self._train_loop, self._config, latest_ckpt,
                             datasets=self._datasets)
-                latest_ckpt, ckpt_index, error = self._drive(
-                    group, run_dir, history, latest_ckpt, ckpt_index)
+                error = self._drive(group, run_dir, history, progress)
+            except (KeyboardInterrupt, SystemExit):
+                # User interrupts are NOT gang failures: tear down (in
+                # the finally) and propagate instead of re-forming.
+                interrupted = True
+                raise
             except BaseException as e:
+                # A rank dying mid-rendezvous surfaces here as an actor
+                # error / formation timeout: a gang failure, restartable.
                 error = e
             finally:
-                group.shutdown()
+                # Checkpoint progress survives a raising attempt: the
+                # restart must resume from what actually persisted, not
+                # the attempt-entry snapshot (stale latest_ckpt would
+                # restart from scratch AND recycle checkpoint indices,
+                # clobbering newer checkpoints on disk).
+                latest_ckpt = progress["ckpt"]
+                ckpt_index = progress["idx"]
+                if group is not None:
+                    gang_death = (isinstance(error, GangMemberDiedError)
+                                  or group.gang_error is not None)
+                    if gang_death and group.gang_error is not None \
+                            and not isinstance(error, GangMemberDiedError):
+                        # Surface the root cause (the dead rank), not the
+                        # survivor's secondary transport error.
+                        error = group.gang_error
+                    # Gang death (or an interrupt): survivors may be
+                    # wedged — force-teardown (SIGKILL) instead of the
+                    # cooperative RPC path.
+                    group.shutdown(
+                        graceful=not (gang_death or interrupted))
+                else:
+                    gang_death = isinstance(error, GangMemberDiedError)
             if error is None:
                 return Result(
                     metrics=history[-1] if history else None,
                     checkpoint=latest_ckpt, path=run_dir,
-                    metrics_history=history)
+                    metrics_history=history, num_restarts=num_restarts,
+                    restart_reasons=restart_reasons)
             last_error = error
+            if attempts_left > 0:
+                num_restarts += 1
+                restart_reasons.append(
+                    f"{type(error).__name__}: {error}")
+                if gang_death:
+                    try:
+                        _metrics()["restarts"].inc()
+                    except Exception:
+                        pass
+                delay = min(backoff * (2 ** (num_restarts - 1)),
+                            backoff_max)
+                logger.warning(
+                    "gang attempt failed (%s); re-forming from %s in "
+                    "%.1fs (%d attempts left)", error,
+                    latest_ckpt.path if latest_ckpt else "scratch",
+                    delay, attempts_left)
+                time.sleep(delay)
         return Result(metrics=history[-1] if history else None,
                       checkpoint=latest_ckpt, path=run_dir,
-                      error=last_error, metrics_history=history)
+                      error=last_error, metrics_history=history,
+                      num_restarts=num_restarts,
+                      restart_reasons=restart_reasons)
 
     # ---------------------------------------------------------------- drive
 
     def _drive(self, group: WorkerGroup, run_dir: str, history: list,
-               latest_ckpt: Optional[Checkpoint], ckpt_index: int):
-        """Poll until every worker finishes; persist rank-0 checkpoints."""
+               progress: Dict[str, Any]):
+        """Poll until every worker finishes; persist rank-0 checkpoints.
+        Checkpoint advancement is written through ``progress`` in place
+        so fit() sees it even when this raises mid-attempt."""
         keep = self.run_config.checkpoint_config.num_to_keep
-        kept: list = []
+        # Rebuild retention state from disk: run_dir persists across gang
+        # restarts, so a fresh local list would exempt earlier attempts'
+        # checkpoints from num_to_keep pruning forever.
+        try:
+            kept: list = sorted(
+                os.path.join(run_dir, d) for d in os.listdir(run_dir)
+                if d.startswith("checkpoint_"))
+        except OSError:
+            kept = []
         while True:
             states = group.poll()
             # Persist checkpoints and record rank-0 metrics, in report order.
@@ -109,24 +187,46 @@ class DataParallelTrainer:
                     if rank != 0:
                         continue
                     if rep["checkpoint_path"]:
-                        ckpt_index += 1
+                        progress["idx"] += 1
                         dst = os.path.join(
-                            run_dir, f"checkpoint_{ckpt_index:06d}")
-                        latest_ckpt = Checkpoint(
+                            run_dir, f"checkpoint_{progress['idx']:06d}")
+                        progress["ckpt"] = Checkpoint(
                             rep["checkpoint_path"]).move_to(dst)
                         kept.append(dst)
                         if keep and len(kept) > keep:
                             old = kept.pop(0)
                             shutil.rmtree(old, ignore_errors=True)
                     history.append(rep["metrics"])
+            # Gang-member death (a dead rank, a supervisor detection, or a
+            # survivor's GangMemberDiedError) is a RESTART condition, not
+            # an application error: the gang is the failure domain.
+            dead = [(r, st) for r, st in enumerate(states)
+                    if st["state"] == "dead"]
+            if dead or group.gang_error is not None:
+                err = group.gang_error
+                if err is None:
+                    rank, st = dead[0]
+                    err = GangMemberDiedError(
+                        group_name=group.group_name, rank=rank,
+                        reason=st["error"] or "actor died")
+                return err
             errored = [(r, st) for r, st in enumerate(states)
                        if st["state"] == "errored"]
+            gang_errored = [
+                (r, st) for r, st in errored
+                if st.get("error_type") == "GangMemberDiedError"]
+            if gang_errored:
+                # A survivor observed a peer die (collective transport
+                # failure / poison) before the driver did: poison the
+                # rest of the gang and restart.
+                rank, st = gang_errored[0]
+                group.poison(f"rank {rank} observed gang death")
+                return group.gang_error
             if errored:
                 rank, st = errored[0]
-                return latest_ckpt, ckpt_index, TrainWorkerError(
-                    rank, st["error"])
+                return TrainWorkerError(rank, st["error"])
             if all(st["state"] == "finished" for st in states):
-                return latest_ckpt, ckpt_index, None
+                return None
             time.sleep(_POLL_PERIOD_S)
 
 

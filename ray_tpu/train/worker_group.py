@@ -13,17 +13,53 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional
 
 import cloudpickle
 
 import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu._private.config import config
 from ray_tpu.train import session as session_mod
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.util.placement_group import (
     placement_group, remove_placement_group,
 )
+
+# Gang fault-tolerance metrics (ride the process's metrics reporter to
+# the GCS metrics table, rendered by the dashboard's /metrics — the same
+# path as the scheduler's lease-grant histogram).
+_gang_metrics = None
+_gang_metrics_lock = threading.Lock()
+
+
+def _metrics():
+    global _gang_metrics
+    if _gang_metrics is None:
+        with _gang_metrics_lock:
+            if _gang_metrics is None:
+                from ray_tpu.util import metrics
+
+                _gang_metrics = {
+                    "restarts": metrics.Counter(
+                        "train_gang_restarts_total",
+                        "Training gangs torn down and re-formed after a "
+                        "gang-member death"),
+                    "poisoned": metrics.Counter(
+                        "gang_poisoned_total",
+                        "Collective groups poisoned after a gang-member "
+                        "death"),
+                    "detect": metrics.Histogram(
+                        "gang_time_to_detection_seconds",
+                        "Time from a gang member's last known-alive "
+                        "signal to the supervisor declaring it dead",
+                        boundaries=[0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0,
+                                    30.0, 60.0]),
+                }
+                metrics.start_reporter()
+    return _gang_metrics
 
 
 class TrainWorker:
@@ -85,6 +121,12 @@ class TrainWorker:
         self._thread.start()
         return True
 
+    def ping(self) -> bool:
+        """Liveness probe served by the actor's main thread (the user loop
+        runs on a background thread, so a healthy-but-busy rank still
+        answers)."""
+        return True
+
     def poll(self) -> Dict[str, Any]:
         """Drain queued reports; non-blocking."""
         sess = session_mod._get_session()
@@ -98,11 +140,14 @@ class TrainWorker:
             })
         state = "running"
         error = None
+        error_type = None
         if sess.finished.is_set():
             state = "errored" if sess.error is not None else "finished"
             if sess.error is not None:
                 error = getattr(sess, "error_tb", str(sess.error))
-        return {"reports": out_reports, "state": state, "error": error}
+                error_type = type(sess.error).__name__
+        return {"reports": out_reports, "state": state, "error": error,
+                "error_type": error_type}
 
     def teardown(self):
         from ray_tpu.parallel import collective
@@ -141,25 +186,171 @@ class WorkerGroup:
                                       strategy=placement_strategy)
             self.pg.wait(timeout_seconds=60)
 
+        # Gang supervision state (see _supervise_loop) — initialized
+        # before any actor creation so the failure path can always call
+        # shutdown() on a half-built group.
+        self._heartbeat_s = max(0.05, float(config.gang_heartbeat_s))
+        self._ping_miss_limit = max(1, int(config.gang_ping_miss_limit))
+        self._poll_timeout_s = float(config.gang_poll_timeout_s)
+        self._dead_lock = threading.Lock()
+        self._dead_ranks: Dict[int, str] = {}
+        self._gang_error: Optional[exceptions.GangMemberDiedError] = None
+        self._poisoned = False
+        self._stop = threading.Event()
+        self._last_alive: Dict[int, float] = {
+            rank: time.time() for rank in range(num_workers)}
+        self._pending_polls: Dict[int, Any] = {}
+        self.workers: List[Any] = []
+
         cls = ray_tpu.remote(TrainWorker)
         num_cpus = resources_per_worker.get("CPU", 1)
         num_tpus = resources_per_worker.get("TPU", 0)
-        self.workers = [
-            cls.options(num_cpus=num_cpus, num_tpus=num_tpus,
-                        placement_group=self.pg,
-                        placement_group_bundle_index=i
-                        + self._bundle_offset,
-                        runtime_env=runtime_env).remote(
-                world_rank=i, world_size=num_workers, local_rank=i,
-                group_name=group_name, backend=backend,
-                experiment_name=experiment_name)
-            for i in range(num_workers)
-        ]
-        # All ranks join concurrently: rank 0 creates the coordinator actor
-        # (the rest poll get_actor), and the xla_dist backend's
-        # jax.distributed rendezvous blocks every rank until the whole
-        # world has joined — a serial rank-0-first get would deadlock it.
-        ray_tpu.get([w.setup_collective.remote() for w in self.workers])
+        try:
+            for i in range(num_workers):
+                self.workers.append(
+                    cls.options(num_cpus=num_cpus, num_tpus=num_tpus,
+                                placement_group=self.pg,
+                                placement_group_bundle_index=i
+                                + self._bundle_offset,
+                                runtime_env=runtime_env).remote(
+                        world_rank=i, world_size=num_workers, local_rank=i,
+                        group_name=group_name, backend=backend,
+                        experiment_name=experiment_name))
+            self._actor_ids = {
+                w._actor_id.hex(): rank
+                for rank, w in enumerate(self.workers)}
+            # All ranks join concurrently: rank 0 creates the coordinator
+            # actor (the rest poll get_actor), and the xla_dist backend's
+            # jax.distributed rendezvous blocks every rank until the whole
+            # world has joined — a serial rank-0-first get would deadlock
+            # it. Bounded, but the bound must EXCEED the members' own
+            # formation budgets (coordinator rendezvous + address exchange
+            # + jax.distributed initialize at 2x rendezvous each) or a
+            # slow-but-healthy formation gets killed and futilely retried.
+            rendezvous_timeout = 4.0 * float(
+                config.collective_rendezvous_timeout_s) + 60.0
+            ray_tpu.get([w.setup_collective.remote()
+                         for w in self.workers],
+                        timeout=rendezvous_timeout)
+        except BaseException:
+            # A failed formation must not leak the half-formed gang:
+            # shutdown() kills whatever actors exist and releases the PG
+            # (each fit() attempt reserves a fresh one).
+            self.shutdown(graceful=False)
+            raise
+        self._supervisor = threading.Thread(
+            target=self._supervise_loop, daemon=True,
+            name=f"rtpu-gang-supervisor-{group_name}")
+        self._supervisor.start()
+
+    # ------------------------------------------------------- gang liveness
+
+    @property
+    def gang_error(self) -> Optional[exceptions.GangMemberDiedError]:
+        return self._gang_error
+
+    def _note_dead(self, rank: int, reason: str):
+        """Record a dead member: observe time-to-detection (since the
+        member's last known-alive signal) and poison the gang."""
+        with self._dead_lock:
+            if rank in self._dead_ranks:
+                return
+            self._dead_ranks[rank] = reason
+        try:
+            _metrics()["detect"].observe(max(
+                0.0, time.time() - self._last_alive.get(rank, time.time())))
+        except Exception:
+            pass
+        self.poison(f"rank {rank} died: {reason}", rank=rank)
+
+    def poison(self, reason: str, rank: Optional[int] = None):
+        """Poison the gang's collective group so survivors wedged in a
+        pending collective raise GangMemberDiedError within ~2x the gang
+        heartbeat, and record the gang error the trainer restarts on."""
+        with self._dead_lock:
+            if self._gang_error is None:
+                self._gang_error = exceptions.GangMemberDiedError(
+                    group_name=self.group_name, rank=rank, reason=reason)
+            if self._poisoned:
+                return
+            self._poisoned = True
+        from ray_tpu.parallel import collective
+
+        try:
+            _metrics()["poisoned"].inc()
+        except Exception:
+            pass
+        collective.poison_group(self.group_name, reason)
+
+    def _supervise_loop(self):
+        """Watch the gang for member death: GCS actor-failure notifications
+        (the ``actor_state`` pubsub channel) plus a bounded liveness ping
+        every ``RAY_TPU_GANG_HEARTBEAT_S``. Detection poisons the group
+        coordinator, so both the driver (via ``gang_error``) and the
+        surviving ranks (via their poison watchers) observe the death
+        within a bounded interval instead of the collective op deadline."""
+        sub = None
+        try:
+            from ray_tpu.experimental import pubsub
+
+            sub = pubsub.subscribe("actor_state")
+        except Exception:
+            pass
+        misses = {rank: 0 for rank in range(self.num_workers)}
+        try:
+            while not self._stop.wait(self._heartbeat_s):
+                # 1) Drain GCS actor-death notifications (push path: no
+                #    polling latency beyond the heartbeat).
+                while sub is not None:
+                    try:
+                        msg = sub.get_nowait()
+                    except Exception:
+                        break
+                    try:
+                        rank = self._actor_ids.get(msg.get("actor_id"))
+                        if rank is not None and msg.get("state") == "DEAD":
+                            self._note_dead(
+                                rank,
+                                msg.get("death_cause") or "actor died")
+                    except Exception:
+                        pass
+                # 2) Bounded liveness pings (catches wedged-alive ranks
+                #    and runs even when pubsub is unavailable). Submit
+                #    all pings first so one slow rank doesn't stretch
+                #    the round (and the detection bound) by N timeouts.
+                with self._dead_lock:
+                    dead = set(self._dead_ranks)
+                pings: Dict[int, Any] = {}
+                for rank, w in enumerate(self.workers):
+                    if rank in dead or self._stop.is_set():
+                        continue
+                    try:
+                        pings[rank] = w.ping.remote()
+                    except Exception as e:
+                        self._note_dead(rank, f"actor died: {e}")
+                round_deadline = time.monotonic() + self._heartbeat_s
+                for rank, ref in pings.items():
+                    try:
+                        ray_tpu.get(ref, timeout=max(
+                            0.05, round_deadline - time.monotonic()))
+                        self._last_alive[rank] = time.time()
+                        misses[rank] = 0
+                    except exceptions.GetTimeoutError:
+                        misses[rank] += 1
+                        if misses[rank] >= self._ping_miss_limit:
+                            self._note_dead(
+                                rank,
+                                f"unresponsive for "
+                                f"{misses[rank]} heartbeats")
+                    except Exception as e:
+                        # RayActorError and friends: the actor is gone.
+                        self._note_dead(rank, f"actor died: {e}")
+        finally:
+            if sub is not None:
+                try:
+                    sub.unsubscribe()
+                except Exception:
+                    pass
 
     def start(self, train_fn: Callable, config: Optional[dict],
               checkpoint: Optional[Checkpoint],
@@ -178,10 +369,53 @@ class WorkerGroup:
                      for i, w in enumerate(self.workers)])
 
     def poll(self) -> List[Dict[str, Any]]:
-        return ray_tpu.get([w.poll.remote() for w in self.workers])
+        """Drain every rank's reports with per-worker error isolation: a
+        dead rank surfaces as ``state="dead"`` instead of one
+        RayActorError aborting the whole poll batch (reports from the
+        surviving ranks — including checkpoints — still come through)."""
+        refs: List[Any] = []
+        for rank, w in enumerate(self.workers):
+            # Re-await a previously timed-out poll instead of submitting
+            # a fresh one: poll() drains the worker's report queue
+            # destructively, so an abandoned ref would swallow reports
+            # (including rank-0 checkpoints) into a reply nobody reads.
+            pending = self._pending_polls.pop(rank, None)
+            if pending is not None:
+                refs.append(pending)
+                continue
+            try:
+                refs.append(w.poll.remote())   # submit ALL first: one
+            except Exception as e:             # slow rank must not
+                refs.append(e)                 # serialize the others
+        out: List[Dict[str, Any]] = []
+        deadline = time.monotonic() + self._poll_timeout_s
+        for rank, ref in enumerate(refs):
+            try:
+                if isinstance(ref, Exception):
+                    raise ref
+                st = ray_tpu.get(ref, timeout=max(
+                    0.1, deadline - time.monotonic()))
+            except exceptions.GetTimeoutError:
+                # Slow, not dead: the supervisor owns death detection.
+                # Keep the ref: its (late) reply is drained next round.
+                self._pending_polls[rank] = ref
+                st = {"reports": [], "state": "running", "error": None,
+                      "error_type": None}
+            except Exception as e:
+                st = {"reports": [], "state": "dead", "error": str(e),
+                      "error_type": type(e).__name__}
+                self._note_dead(rank, f"actor died: {e}")
+            out.append(st)
+        return out
 
     def shutdown(self, graceful: bool = True):
-        if graceful:
+        """Tear the gang down. ``graceful=False`` is the gang-death path:
+        survivors may be wedged inside a poisoned collective (or a
+        half-dead jax.distributed world), so skip the cooperative
+        teardown RPC and go straight to SIGKILL — a fresh gang under a
+        fresh group name replaces them."""
+        self._stop.set()
+        if graceful and self._gang_error is None:
             try:
                 ray_tpu.get([w.teardown.remote() for w in self.workers],
                             timeout=10)
@@ -192,6 +426,17 @@ class WorkerGroup:
                 ray_tpu.kill(w)
             except Exception:
                 pass
+        # The group coordinator is a detached named actor: rank 0 kills it
+        # on graceful teardown, but after a gang death nobody does — reap
+        # it from here so poisoned coordinators don't accumulate.
+        from ray_tpu.parallel import collective
+
+        try:
+            coord = ray_tpu.get_actor(
+                collective._COORD_NAME_FMT.format(self.group_name))
+            ray_tpu.kill(coord)
+        except Exception:
+            pass
         if self._owns_pg:
             try:
                 remove_placement_group(self.pg)

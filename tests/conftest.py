@@ -26,6 +26,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests (chaos sweeps); excluded from tier-1 "
+        "via -m 'not slow'")
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu

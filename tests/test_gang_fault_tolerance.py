@@ -1,0 +1,392 @@
+"""Gang fault tolerance: slice-death detection, collective poisoning, and
+checkpointed gang restart — the TPU-first flagship scenario (SURVEY §7(c),
+ROADMAP "Mid-step gang failure").
+
+The gang is the failure domain: one dead `xla_dist` rank invalidates the
+whole mesh (on a TPU pod, one dead host kills the slice). These tests
+SIGKILL one rank mid-step and prove, end to end:
+
+- bounded-time detection (supervisor heartbeat + GCS actor-death push,
+  NOT the old hardcoded 300 s collective deadline),
+- survivor unwedge (the poisoned collective raises GangMemberDiedError),
+- gang re-formation under a fresh group name + placement group,
+- resume from the latest persisted checkpoint with a correct final result,
+- restart/poison counters on the dashboard's /metrics.
+
+Every wait in this file is deadline-driven (no unbounded get): a
+regression in detection fails fast instead of hanging the suite.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu._private.config import config
+from ray_tpu.train import (
+    Checkpoint, FailureConfig, JaxTrainer, RunConfig, ScalingConfig,
+)
+
+HEARTBEAT_S = 1.0       # RAY_TPU_GANG_HEARTBEAT_S for these tests
+DETECT_BOUND_S = 2 * HEARTBEAT_S + 3.0   # 2x heartbeat + CI slack
+
+
+@pytest.fixture
+def gang_cluster():
+    old = {k: config.get(k)
+           for k in ("gang_heartbeat_s", "gang_restart_backoff_s")}
+    config.set("gang_heartbeat_s", HEARTBEAT_S)
+    config.set("gang_restart_backoff_s", 0.1)
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+    for k, v in old.items():
+        config.set(k, v)
+
+
+def _fit_bounded(trainer, timeout_s):
+    """fit() under a hard deadline — the suite must fail fast, not hang,
+    if detection/restart regresses."""
+    out = {}
+
+    def run():
+        try:
+            out["result"] = trainer.fit()
+        except BaseException as e:   # surfaced below
+            out["error"] = e
+
+    th = threading.Thread(target=run, daemon=True, name="fit-bounded")
+    th.start()
+    th.join(timeout_s)
+    assert out, f"fit() exceeded its {timeout_s}s deadline (wedged?)"
+    if "error" in out:
+        raise out["error"]
+    return out["result"]
+
+
+def _wait_for(pred, timeout, msg):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _gang_loop(cfg):
+    """Per-step compiled allreduce over the gang (xla_dist); rank 0
+    checkpoints every step. Writes side-channel files the test uses to
+    find rank pids and to record the survivor's unwedge latency."""
+    import os
+    import time
+
+    import numpy as np
+
+    from ray_tpu import train
+    from ray_tpu.parallel import collective
+    from ray_tpu.train import Checkpoint
+
+    side = cfg["side_dir"]
+    sess = train.session._get_session()
+    g = collective.get_group(sess.collective_group_name)
+    rank = train.get_world_rank()
+
+    start_step = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        start_step = ckpt.to_dict()["step"] + 1
+
+    tmp = os.path.join(side, f"rank{rank}.pid.tmp")
+    with open(tmp, "w") as f:
+        f.write(str(os.getpid()))
+    os.replace(tmp, os.path.join(side, f"rank{rank}.pid"))
+
+    for step in range(start_step, cfg["steps"]):
+        # Asymmetric pacing: rank 0 enters the collective immediately and
+        # blocks there while the other ranks "compute" (sleep) — so a
+        # SIGKILL of rank 1 lands while the survivor is INSIDE the
+        # compiled step, the scenario the poison path must unwedge.
+        if rank != 0:
+            time.sleep(cfg["step_s"])
+        t_op = time.time()
+        try:
+            out = g.allreduce(np.full((4,), float(rank + 1), np.float32))
+        except BaseException as e:
+            # Record how long the survivor sat in the failed collective
+            # (the unwedge bound the flagship asserts on).
+            with open(os.path.join(side, f"unwedge_rank{rank}"), "w") as f:
+                f.write(f"{type(e).__name__}:{time.time() - t_op:.3f}")
+            raise
+        if rank == 0:
+            train.report(
+                {"step": step,
+                 "allreduce0": float(np.asarray(out).ravel()[0])},
+                checkpoint=Checkpoint.from_dict({"step": step}))
+
+
+def _run_dir_has_checkpoint(run_dir):
+    try:
+        return any(d.startswith("checkpoint_") for d in os.listdir(run_dir))
+    except OSError:
+        return False
+
+
+def test_sigkill_one_rank_mid_step_recovers(gang_cluster, tmp_path):
+    """The flagship: SIGKILL one xla_dist rank during the stepped run;
+    the survivor unwedges within ~2x the gang heartbeat, the gang
+    re-forms, training resumes from the latest checkpoint, and the final
+    result is correct with >=1 recorded restart."""
+    side = str(tmp_path / "side")
+    os.makedirs(side, exist_ok=True)
+    steps = 8
+    run_dir = str(tmp_path / "gangkill")
+
+    record = {}
+
+    def killer():
+        # Wait for rank 1's pid AND one persisted checkpoint (so there is
+        # something to resume from), then SIGKILL rank 1 mid-run. Kill
+        # exactly once: the re-formed gang must survive.
+        pid_path = os.path.join(side, "rank1.pid")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if os.path.exists(pid_path) and _run_dir_has_checkpoint(run_dir):
+                try:
+                    pid = int(open(pid_path).read())
+                except (OSError, ValueError):
+                    time.sleep(0.05)
+                    continue
+                record["t_kill"] = time.time()
+                os.kill(pid, signal.SIGKILL)
+                return
+            time.sleep(0.05)
+        record["error"] = "killer never found a target"
+
+    trainer = JaxTrainer(
+        _gang_loop,
+        train_loop_config={"side_dir": side, "steps": steps,
+                           "step_s": 0.3},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="gangkill", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2)),
+    )
+    kth = threading.Thread(target=killer, daemon=True)
+    kth.start()
+    result = _fit_bounded(trainer, timeout_s=180)
+    t_done = time.time()
+
+    assert "t_kill" in record, record.get("error", "kill never happened")
+    # Recovery end to end: the run finished despite the mid-step SIGKILL.
+    assert result.ok, result.error
+    assert result.num_restarts >= 1
+    assert any("GangMemberDied" in r for r in result.restart_reasons), \
+        result.restart_reasons
+    # Kill-to-done is bounded nowhere near the old 300 s deadline.
+    assert t_done - record["t_kill"] < 120
+
+    # Correctness: every reported step saw the full-gang allreduce (1+2),
+    # the final step completed, and the restart resumed from a checkpoint
+    # (no step before the resume point was recomputed more than the
+    # checkpoint lag allows).
+    hist = result.metrics_history
+    assert hist and all(m["allreduce0"] == 3.0 for m in hist)
+    assert hist[-1]["step"] == steps - 1
+    assert {m["step"] for m in hist} == set(range(steps))
+    assert result.checkpoint is not None
+    assert result.checkpoint.to_dict()["step"] == steps - 1
+
+    # Survivor unwedge: rank 0 raised GangMemberDiedError out of the
+    # poisoned/severed collective within the detection bound — not the
+    # collective op deadline.
+    unwedge = os.path.join(side, "unwedge_rank0")
+    assert os.path.exists(unwedge), \
+        "survivor never recorded an unwedge (killed while idle?)"
+    err_name, elapsed = open(unwedge).read().split(":")
+    assert err_name == "GangMemberDiedError", err_name
+    # The survivor entered the collective up to one step before the kill;
+    # everything past that is detection/unwedge latency.
+    assert float(elapsed) <= 0.3 + DETECT_BOUND_S + 0.3, \
+        f"survivor sat {elapsed}s in the dead collective"
+
+    # Detection latency (supervisor heartbeat) was observed and bounded.
+    from ray_tpu.util import metrics
+
+    samples = {s["name"]: s for s in metrics.collect_samples()}
+    assert samples["train_gang_restarts_total"]["value"] >= 1
+    assert samples["gang_poisoned_total"]["value"] >= 1
+    assert samples["gang_time_to_detection_seconds_count"]["value"] >= 1
+    assert samples["gang_time_to_detection_seconds_sum"]["value"] \
+        <= DETECT_BOUND_S * \
+        samples["gang_time_to_detection_seconds_count"]["value"]
+
+    # Observability: the counters flow to the dashboard's /metrics.
+    assert metrics.report_to_gcs()
+    from ray_tpu.dashboard import start_dashboard
+
+    _actor, port = start_dashboard(port=18277)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=15) as r:
+        text = r.read().decode()
+    assert "train_gang_restarts_total" in text
+    assert "gang_poisoned_total" in text
+    assert "gang_time_to_detection_seconds" in text
+
+
+def test_poison_unwedges_pending_collective(gang_cluster):
+    """Collective poisoning in isolation: a rank pending in a store-backend
+    collective (its peer never shows up) raises GangMemberDiedError within
+    ~2x the gang heartbeat of the group being poisoned — it does NOT wait
+    out the collective op deadline."""
+    from ray_tpu.parallel import collective
+
+    g = collective.init_collective_group(
+        2, 0, backend="store", group_name="poison_unit")
+    res = {}
+
+    def run():
+        t0 = time.time()
+        try:
+            g.barrier()
+            res["err"] = None
+        except BaseException as e:
+            res["err"] = e
+            res["elapsed"] = time.time() - t0
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    time.sleep(0.5)   # the barrier is now pending (rank 1 never joins)
+    t_poison = time.time()
+    assert collective.poison_group("poison_unit",
+                                   "rank 1 SIGKILLed (test)")
+    th.join(DETECT_BOUND_S + 2)
+    assert not th.is_alive(), \
+        "poisoned collective still pending past the detection bound"
+    assert isinstance(res["err"], exceptions.GangMemberDiedError)
+    assert time.time() - t_poison <= DETECT_BOUND_S + 2
+    assert "SIGKILLed" in str(res["err"])
+    collective.destroy_collective_group("poison_unit")
+
+
+def _poll_gang_loop(cfg=None):
+    import time
+
+    from ray_tpu import train
+
+    for i in range(1200):
+        time.sleep(0.05)
+        if train.get_world_rank() == 0 and i % 20 == 0:
+            train.report({"i": i})
+
+
+def test_worker_group_poll_isolates_dead_rank(gang_cluster):
+    """poll() hardening: a dead rank surfaces as state='dead' instead of
+    one RayActorError aborting the whole poll batch, and the supervisor
+    records a gang error (poisoning the group) within a bounded time."""
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    group = WorkerGroup(2, {"CPU": 1}, backend="store",
+                        group_name="pollgang", experiment_name="pg")
+    try:
+        group.start(_poll_gang_loop, None, None)
+        states = group.poll()          # healthy: no raise, all running
+        assert [s["state"] for s in states] == ["running", "running"]
+
+        ray_tpu.kill(group.workers[1])
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            states = group.poll()      # must never raise
+            if states[1]["state"] == "dead":
+                break
+            time.sleep(0.2)
+        assert states[1]["state"] == "dead", states
+        assert states[0]["state"] == "running", states
+
+        _wait_for(lambda: group.gang_error is not None,
+                  timeout=DETECT_BOUND_S + 5,
+                  msg="supervisor to record the gang error")
+        assert isinstance(group.gang_error, exceptions.GangMemberDiedError)
+        assert group.gang_error.rank == 1
+    finally:
+        group.shutdown(graceful=False)
+
+
+@pytest.mark.slow
+def test_chaos_gang_killer_sweep(tmp_path):
+    """NodeKiller-style chaos sweep: random gang-rank SIGKILLs during a
+    short JaxTrainer.fit() run; the trainer must keep re-forming from
+    checkpoints and finish correctly."""
+    old = {k: config.get(k)
+           for k in ("gang_heartbeat_s", "gang_restart_backoff_s")}
+    config.set("gang_heartbeat_s", HEARTBEAT_S)
+    config.set("gang_restart_backoff_s", 0.1)
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        side = str(tmp_path / "side")
+        os.makedirs(side, exist_ok=True)
+        steps = 10
+        run_dir = str(tmp_path / "gangchaos")
+        stop = threading.Event()
+        kills = []
+
+        def killer():
+            import random
+
+            rng = random.Random(0)
+            killed_pids = set()
+            deadline = time.time() + 240
+            while (not stop.is_set() and len(kills) < 2
+                   and time.time() < deadline):
+                if not _run_dir_has_checkpoint(run_dir):
+                    time.sleep(0.1)
+                    continue
+                rank = rng.choice([0, 1])
+                path = os.path.join(side, f"rank{rank}.pid")
+                try:
+                    pid = int(open(path).read())
+                except (OSError, ValueError):
+                    time.sleep(0.1)
+                    continue
+                if pid in killed_pids:   # wait for the re-formed gang
+                    time.sleep(0.2)
+                    continue
+                killed_pids.add(pid)
+                kills.append((rank, pid, time.time()))
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                time.sleep(3.0)   # let the gang re-form and progress
+
+        trainer = JaxTrainer(
+            _gang_loop,
+            train_loop_config={"side_dir": side, "steps": steps,
+                               "step_s": 0.25},
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                name="gangchaos", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=6)),
+        )
+        kth = threading.Thread(target=killer, daemon=True)
+        kth.start()
+        try:
+            result = _fit_bounded(trainer, timeout_s=420)
+        finally:
+            stop.set()
+        assert result.ok, result.error
+        assert kills, "chaos killer never fired"
+        assert result.num_restarts >= 1
+        hist = result.metrics_history
+        assert hist[-1]["step"] == steps - 1
+        assert all(m["allreduce0"] == 3.0 for m in hist)
+    finally:
+        ray_tpu.shutdown()
+        for k, v in old.items():
+            config.set(k, v)

@@ -213,6 +213,144 @@ def test_nm_death_with_outstanding_local_grants():
         cluster.shutdown()
 
 
+def test_hung_startup_worker_falls_back_to_gcs(local_cluster):
+    """r7 finding (a): a worker that hangs during startup (the NM's
+    deferred lease reply never resolves) must not wedge that shape's
+    pipeline — the caller bounds the local grant by the worker-start
+    timeout and spills back to the GCS-brokered path."""
+    nm = _nm()
+    lm = _worker()._lease_mgr
+    # Simulate the hang: checkout never replies (the spawned worker is
+    # alive but never registers, so the deferred reply is parked forever).
+    orig_checkout = nm._checkout_worker
+    nm._checkout_worker = lambda *a, **k: None
+    lm._worker_timeout = 1.0
+    try:
+        @ray_tpu.remote(num_cpus=2)
+        def two_cpu():
+            return "ok"
+
+        t0 = time.time()
+        # Before the fix this get wedges: the local-lease future never
+        # resolves, the shape's queue never drains, no GCS fallback.
+        assert ray_tpu.get(two_cpu.remote(), timeout=30) == "ok"
+        assert time.time() - t0 < 30
+    finally:
+        nm._checkout_worker = orig_checkout
+        lm._worker_timeout = float(config.worker_start_timeout_s) + 10.0
+
+
+def test_nm_reaps_hung_startup_lease_worker(local_cluster):
+    """NM-side bound for the same finding: a STARTING worker holding a
+    deferred lease reply past worker_start_timeout_s is killed, which
+    errors the deferred reply (caller falls back) and releases the
+    grant's ledger hold via the normal death path."""
+    import subprocess
+    import sys as _sys
+
+    from ray_tpu._private import node_manager as nm_mod
+    from ray_tpu._private.ids import WorkerID
+
+    nm = _nm()
+    old_timeout = config.worker_start_timeout_s
+    config.set("worker_start_timeout_s", 0.5)
+    proc = subprocess.Popen([_sys.executable, "-c",
+                             "import time; time.sleep(300)"])
+
+    errored = []
+
+    class _FakeConn:
+        def reply_error(self, msg_id, err):
+            errored.append(err)
+
+    handle = nm_mod.WorkerHandle(
+        worker_id=WorkerID.from_random().binary(), proc=proc)
+    handle.lease_reply = (_FakeConn(), 0)   # deferred reply parked
+    handle.busy_since = time.time()
+    try:
+        with nm._lock:
+            nm._workers[handle.worker_id] = handle
+        _wait_for(lambda: proc.poll() is not None, timeout=15,
+                  msg="hung startup worker to be reaped")
+        _wait_for(lambda: errored, timeout=15,
+                  msg="deferred lease reply to be errored")
+    finally:
+        config.set("worker_start_timeout_s", old_timeout)
+        try:
+            proc.kill()
+        except Exception:
+            pass
+        with nm._lock:
+            nm._workers.pop(handle.worker_id, None)
+
+
+def test_daemon_pool_concurrent_submit_spawns(local_cluster):
+    """r7 finding (b): two back-to-back submits that both observe one
+    idle thread must not BOTH skip the spawn — the idle check-and-reserve
+    is atomic under the pool lock, so the second submit spawns and both
+    fns run concurrently."""
+    import threading
+
+    from ray_tpu._private.worker import _DaemonPool
+
+    pool = _DaemonPool(4, "test-pool")
+    warm = threading.Event()
+    pool.submit(warm.set)
+    assert warm.wait(5)
+    _wait_for(lambda: pool._idle == 1, timeout=5, msg="one idle thread")
+
+    release = threading.Event()
+    started = [threading.Event(), threading.Event()]
+
+    def blocker(i):
+        started[i].set()
+        release.wait(30)
+
+    # Back-to-back: with the racy accounting both submits see _idle == 1
+    # and neither spawns — the second fn strands behind the first.
+    pool.submit(lambda: blocker(0))
+    pool.submit(lambda: blocker(1))
+    try:
+        assert started[0].wait(5), "first submit never ran"
+        assert started[1].wait(5), \
+            "second submit stranded: spawn/idle race lost a worker"
+    finally:
+        release.set()
+
+
+def test_spawn_failure_keeps_local_capacity(local_cluster):
+    """r7 finding (c): _on_create_actor/_on_lease_task must release their
+    _local_avail mirror-subtract when _spawn_worker raises — repeated
+    spawn failures must not permanently shrink local capacity."""
+    from ray_tpu._private.ids import ActorID, JobID
+    from ray_tpu._private.task_spec import ActorCreationSpec
+
+    nm = _nm()
+    baseline = dict(nm._local_avail.to_dict())
+
+    def boom(*a, **k):
+        raise OSError("spawn failed (injected)")
+
+    orig_spawn = nm._spawn_worker
+    nm._spawn_worker = boom
+    try:
+        for _ in range(3):
+            spec = ActorCreationSpec(
+                actor_id=ActorID.from_random(),
+                job_id=JobID.from_random(),
+                class_key="nonexistent", args=b"", arg_deps=[],
+                resources={"CPU": 1.0},
+                # env_vars force the fresh-spawn path (no pooled reuse).
+                runtime_env={"env_vars": {"X": "1"}})
+            nm._on_create_actor(spec)
+        _wait_for(lambda: not nm._res_held_actors,
+                  msg="actor holds released after spawn failure")
+        assert nm._local_avail.to_dict() == baseline, \
+            "spawn failures leaked local capacity"
+    finally:
+        nm._spawn_worker = orig_spawn
+
+
 def test_local_scheduling_disabled_is_centralized(monkeypatch):
     """The A/B baseline: toggle off -> no local grants, every placement
     serializes through the GCS (classic path), tasks still complete."""

@@ -118,6 +118,29 @@ def test_failure_restart_from_checkpoint(ray_4cpu, tmp_path):
     assert steps == [0, 1, 2, 3, 4]
 
 
+def test_num_to_keep_pruning_survives_restart(ray_4cpu, tmp_path):
+    """Checkpoint retention is enforced across gang restarts: _drive
+    rebuilds its kept-list from run_dir, so earlier attempts' checkpoints
+    still count against num_to_keep."""
+    from ray_tpu.train import CheckpointConfig
+
+    marker = str(tmp_path / "crash_marker2")
+    trainer = DataParallelTrainer(
+        _flaky_loop,
+        train_loop_config={"steps": 6, "marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="prune", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+            checkpoint_config=CheckpointConfig(num_to_keep=2)),
+    )
+    result = trainer.fit()
+    assert result.ok, result.error
+    run_dir = str(tmp_path / "prune")
+    ckpts = [d for d in os.listdir(run_dir) if d.startswith("checkpoint_")]
+    assert len(ckpts) <= 2, ckpts
+
+
 def test_failure_exhausts_retries(ray_4cpu, tmp_path):
     def always_fails(config):
         raise ValueError("boom")
