@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# AddressSanitizer + UBSan pass over the store.cpp allocation/refcount
+# paths — the memory-safety sibling of run_tsan_store.sh (which owns the
+# lock paths; ISSUE 5 "extend the native-store sanitizer wiring beyond
+# TSan").
+#
+# Rebuilds the shm store library with -fsanitize=address,undefined,
+# preloads libasan/libubsan into python (the interpreter itself is
+# uninstrumented, so every report points at store.cpp, not python
+# internals), and drives benchmarks/tsan_store_stress.py: 8 threads in
+# ONE process hammering create/seal/get/evict/delete/stats over a shared
+# oid pool on a tiny arena. ASan sees heap/global/stack overflows and
+# use-after-free in the store's client-side bookkeeping; UBSan catches
+# misaligned arena arithmetic and integer overflow in offset math. The
+# mmap'd arena ITSELF is not ASan-poisoned memory (ASan cannot redzone
+# inside a shared mapping), so arena-interior overruns are TSan/stress
+# territory — what this pass owns is everything on the C++ heap around
+# it: per-client handles, the object table, stat structs.
+#
+# Leak detection is OFF: LSan would intercept the (uninstrumented)
+# interpreter's allocations and drown real findings in python noise.
+#
+# The instrumented library is built in a temp dir and injected via
+# RAY_TPU_STORE_SO (config knob `store_so`) — the tracked
+# librtpu_store.so is never touched.
+#
+# Usage: benchmarks/run_asan_store.sh
+#   TSAN_STRESS_SECONDS=30 for a longer soak (default 8; the hammer is
+#   shared with the TSan harness).
+# Findings are summarized on stdout and kept under $ASAN_LOG_DIR
+# (default /tmp). See README "Correctness tooling" for the standing
+# findings note from the last documented pass.
+set -uo pipefail
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+SRC="$ROOT/ray_tpu/object_store/store.cpp"
+TMPDIR_ASAN="$(mktemp -d /tmp/rtpu-asan-XXXXXX)"
+SO="$TMPDIR_ASAN/librtpu_store_asan.so"
+LOG="${ASAN_LOG_DIR:-/tmp}/rtpu_store_asan"
+trap 'rm -rf "$TMPDIR_ASAN"' EXIT
+
+echo "== building $(basename "$SO") with -fsanitize=address,undefined"
+# Recoverable UBSan (the default): every violation logs and execution
+# continues, so one report cannot mask the rest — matching
+# halt_on_error=0 below; the report grep still fails the run.
+g++ -O1 -g -fsanitize=address,undefined \
+    -shared -fPIC -pthread -o "$SO" "$SRC" || exit 1
+
+LIBASAN="$(g++ -print-file-name=libasan.so)"
+LIBUBSAN="$(g++ -print-file-name=libubsan.so)"
+rm -f "$LOG".*
+
+echo "== driving the multithreaded store hammer under ASan+UBSan"
+LD_PRELOAD="$LIBASAN $LIBUBSAN" \
+RAY_TPU_STORE_SO="$SO" \
+ASAN_OPTIONS="detect_leaks=0 halt_on_error=0 exitcode=0 log_path=$LOG abort_on_error=0" \
+UBSAN_OPTIONS="print_stacktrace=1 halt_on_error=0 log_path=$LOG" \
+python "$ROOT/benchmarks/tsan_store_stress.py" "$@"
+rc=$?
+
+echo
+reports=$(cat "$LOG".* 2>/dev/null | grep -cE \
+    "ERROR: AddressSanitizer|runtime error:" || true)
+echo "== ASan/UBSan reports: ${reports:-0} (logs: $LOG.*)"
+cat "$LOG".* 2>/dev/null | grep -A 6 -E \
+    "ERROR: AddressSanitizer|runtime error:" | head -60
+if [ "${reports:-0}" -gt 0 ]; then
+    echo "== ASan/UBSan flagged the store: triage the logs above"
+    exit 1
+fi
+echo "== clean pass"
+exit $rc

@@ -9,6 +9,13 @@ reference framework.
 from ray_tpu.version import __version__  # noqa: F401
 from ray_tpu import exceptions  # noqa: F401
 
+# Runtime lock-order witness (RAY_TPU_LOCKDEP_ENABLED): must install
+# BEFORE any ray_tpu module creates its locks, so it rides the very
+# first import.
+from ray_tpu._private import lockdep as _lockdep
+
+_lockdep.maybe_install()
+
 # Public API is populated as the runtime comes up; populated lazily to keep
 # `import ray_tpu` light (no jax import on the control path).
 from ray_tpu.api import (  # noqa: F401

@@ -41,9 +41,26 @@ class Config:
 
     def _load_env(self, entry: _ConfigEntry) -> Any:
         raw = os.environ.get(_ENV_PREFIX + entry.name.upper())
-        if raw is None:
+        if raw is None or raw == "":
+            # Set-but-empty (`RAY_TPU_FOO= cmd`) means unset: coercing
+            # "" would crash int/float knobs and silently flip bool
+            # knobs to False.
             return entry.default
         return self._coerce(entry, raw)
+
+    def refresh_from_env(self, name: str) -> Any:
+        """Re-read ``RAY_TPU_<NAME>`` into the registry (typed) and
+        return the current value. For the few knobs whose consumers
+        historically honored env changes made AFTER import (address,
+        store_so, usage_stats_enabled): the env, when present, wins over
+        the import-time snapshot; an unset env leaves programmatic
+        ``set()`` values untouched."""
+        entry = self._entries[name]
+        raw = os.environ.get(_ENV_PREFIX + name.upper())
+        if raw is not None and raw != "":
+            with self._lock:
+                self._values[name] = self._coerce(entry, raw)
+        return self._values[name]
 
     @staticmethod
     def _coerce(entry: _ConfigEntry, raw: Any) -> Any:
@@ -130,6 +147,11 @@ _d("device_objects_enabled", True,
    "stream) — the A/B baseline in benchmarks/microbench_compare.py.")
 _d("object_store_dir", "/dev/shm",
    "Directory backing the store arena file (tmpfs for zero-copy).")
+_d("store_so", "",
+   "Override path of the native store library (librtpu_store.so). Used "
+   "by the sanitizer harnesses (benchmarks/run_tsan_store.sh, "
+   "run_asan_store.sh) to inject an instrumented build without "
+   "touching the tracked one. Empty = the bundled library.")
 _d("object_store_eviction", True, "Enable LRU eviction when full.")
 _d("object_spilling_threshold", 0.8,
    "Store fill fraction above which sealed objects spill to disk "
@@ -268,6 +290,16 @@ _d("memory_limit_bytes", 0,
    "system MemTotal. Tests set a small value to trigger OOM kills.")
 
 # --- gcs --------------------------------------------------------------------
+_d("address", "",
+   "Default cluster address for init()/CLI when none is given "
+   "explicitly (the RAY_TPU_ADDRESS of the classic `ray start` "
+   "workflow). Empty = start a new local cluster.")
+_d("gcs_rpc_timeout_s", 60.0,
+   "Bound on driver/worker -> GCS control RPCs (register, actor "
+   "bookkeeping, KV, state queries). A wedged GCS then surfaces as a "
+   "TimeoutError at the call site instead of a forever-parked control "
+   "thread; paths with their own deadline semantics (e.g. blocking "
+   "named-actor lookup) pass an explicit timeout instead.")
 _d("gcs_storage", "memory", "GCS table storage backend: memory | file.")
 _d("gcs_file_storage_path", "", "Path for the file storage backend.")
 _d("gcs_recovery_grace_s", 10.0,
@@ -281,6 +313,21 @@ _d("tpu_chips_per_host", 4,
    "Chips driven by one host on the modeled pod (v4/v5p default).")
 _d("tpu_topology", "", "Override slice topology string, e.g. '2x2x1'.")
 
+# --- correctness tooling ----------------------------------------------------
+_d("lockdep_enabled", False,
+   "Runtime lock-order witness (ray_tpu._private.lockdep): wrap every "
+   "threading.Lock/RLock created by ray_tpu code, record the actual "
+   "acquisition order per thread into a creation-site-keyed graph, and "
+   "capture the witness cycle the first time an acquisition closes one "
+   "(the interleaving that WOULD deadlock, caught on a run that merely "
+   "inverted order). Violations are recorded, not raised; the test "
+   "harness asserts none at test boundaries. The runtime twin of "
+   "raylint's static lock-order checker. Env: RAY_TPU_LOCKDEP_ENABLED.")
+
 # --- logging ----------------------------------------------------------------
 _d("log_dir", "", "Session log directory; empty = <session_dir>/logs.")
 _d("log_to_driver", True, "Stream worker logs back to the driver.")
+_d("usage_stats_enabled", True,
+   "Anonymous usage-stats reporting toggle "
+   "(RAY_TPU_USAGE_STATS_ENABLED=0 opts out, matching the reference's "
+   "RAY_USAGE_STATS_ENABLED contract).")

@@ -1,0 +1,110 @@
+"""raylint CLI.
+
+    python -m ray_tpu._private.lint                 # lint vs baseline
+    python -m ray_tpu._private.lint --no-baseline   # raw violation list
+    python -m ray_tpu._private.lint --write-baseline
+    python -m ray_tpu._private.lint --explain lock-order
+    python -m ray_tpu._private.lint --list-rules
+    python -m ray_tpu._private.lint --json
+
+Exit codes: 0 clean (no non-baselined violations, no stale baseline
+entries), 1 ratchet failure, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ray_tpu._private.lint import core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu._private.lint",
+        description="raylint: distributed-correctness static analysis "
+                    "for the TPU control plane")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the ray_tpu "
+                         "package)")
+    ap.add_argument("--baseline", default=core.DEFAULT_BASELINE,
+                    help="baseline file (default: the committed ratchet)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, ignore the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current run "
+                         "(only after FIXING violations — never to "
+                         "absorb new ones)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--explain", metavar="RULE",
+                    help="print the rationale for one rule and exit")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    checkers = {c.RULE: c for c in core.all_checkers()}
+
+    if args.list_rules:
+        for rule, c in sorted(checkers.items()):
+            first = c.EXPLAIN.strip().splitlines()[0]
+            print(f"{rule:22s} {first}")
+        return 0
+
+    if args.explain:
+        c = checkers.get(args.explain)
+        if c is None:
+            print(f"unknown rule: {args.explain!r} (try --list-rules)",
+                  file=sys.stderr)
+            return 2
+        print(c.EXPLAIN.rstrip())
+        return 0
+
+    if args.rule:
+        unknown = [r for r in args.rule if r not in checkers]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    violations = core.run_lint(args.paths or None,
+                               rules=set(args.rule) if args.rule else None)
+
+    if args.write_baseline:
+        core.save_baseline(violations, args.baseline)
+        print(f"baseline written: {len(violations)} entr"
+              f"{'y' if len(violations) == 1 else 'ies'} -> "
+              f"{args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, stale = violations, []
+    else:
+        baseline = core.load_baseline(args.baseline)
+        new, stale = core.diff_baseline(violations, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "violations": [v.__dict__ for v in new],
+            "stale_baseline": stale,
+            "total_current": len(violations),
+        }, indent=1))
+    else:
+        for v in new:
+            print(v)
+        for k in stale:
+            print(f"STALE baseline entry (fixed? run --write-baseline): "
+                  f"{k}")
+        n_base = len(violations) - len(new)
+        tail = f" ({n_base} baselined)" if n_base and not args.no_baseline \
+            else ""
+        print(f"raylint: {len(new)} violation"
+              f"{'' if len(new) == 1 else 's'}, {len(stale)} stale "
+              f"baseline entr{'y' if len(stale) == 1 else 'ies'}{tail}")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

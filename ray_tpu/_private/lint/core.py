@@ -1,0 +1,416 @@
+"""raylint core: source model, suppression parsing, baseline ratchet.
+
+Design notes
+------------
+- Pure ``ast`` + ``tokenize``; no jax / no runtime imports of the linted
+  modules, so tier-1 can run this without an accelerator stack.
+- A violation's identity is ``rule::path::snippet`` (the stripped source
+  line), NOT the line number — line churn from unrelated edits must not
+  invalidate the baseline.
+- Suppressions are explicit and must carry the rule name:
+  ``# raylint: disable=<rule>[,<rule>...]`` on the flagged line (or the
+  first line of the enclosing statement), or
+  ``# raylint: disable-next=<rule>`` on the preceding line. A bare
+  ``disable`` (no rule) is deliberately NOT honored: the tool ships
+  trusted, not muted.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Repo root = parent of the ray_tpu package directory.
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+REPO_ROOT = os.path.dirname(_PKG_DIR)
+
+# Modules forming the control plane: daemon loops, supervisors, the
+# collective/gang layer, and the scheduler. The wait/lock/exception
+# checkers are scoped here — a missing timeout in a CLI helper is noise;
+# in a daemon or a collective it wedges a node or a gang.
+CONTROL_PLANE = (
+    "ray_tpu/_private/node_manager.py",
+    "ray_tpu/_private/gcs.py",
+    "ray_tpu/_private/lease.py",
+    "ray_tpu/_private/worker.py",
+    "ray_tpu/_private/worker_main.py",
+    "ray_tpu/_private/protocol.py",
+    "ray_tpu/_private/device_objects.py",
+    "ray_tpu/parallel/collective.py",
+    "ray_tpu/train/worker_group.py",
+)
+
+# The subset where a swallowed GangMemberDiedError / RayActorError turns
+# a bounded failure into a silent wedge (gang + supervisor paths).
+GANG_PATHS = (
+    "ray_tpu/parallel/collective.py",
+    "ray_tpu/train/worker_group.py",
+    "ray_tpu/train/data_parallel.py",
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*raylint:\s*(disable-next|disable)\s*=\s*"
+    r"([a-z0-9_-]+(?:\s*,\s*[a-z0-9_-]+)*)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int          # 1-based, for display only
+    message: str
+    snippet: str       # stripped source of the flagged line
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.snippet}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Source:
+    """One parsed python file with parent links and suppression map."""
+
+    def __init__(self, abspath: str, rel: str, text: str):
+        self.abspath = abspath
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        self.modname = rel[:-3].replace("/", ".")
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._raylint_parent = node  # type: ignore[attr-defined]
+        self.suppressions = self._parse_suppressions(text)
+
+    def _parse_suppressions(self, text: str) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        lines = text.splitlines()
+
+        def next_code_line(after: int) -> int:
+            """1-based line of the next non-blank, non-comment line —
+            ``disable-next`` over a multi-line comment applies to the
+            statement the comment block annotates."""
+            i = after  # 0-based index of the line after the comment
+            while i < len(lines):
+                stripped = lines[i].strip()
+                if stripped and not stripped.startswith("#"):
+                    return i + 1
+                i += 1
+            return after + 1
+
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(text).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+                line = tok.start[0]
+                if m.group(1) == "disable-next":
+                    line = next_code_line(line)
+                out.setdefault(line, set()).update(rules)
+        except tokenize.TokenError:
+            pass
+        return out
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, *linenos: int) -> bool:
+        for ln in linenos:
+            if rule in self.suppressions.get(ln, ()):
+                return True
+        return False
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_raylint_parent", None)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing(self, node: ast.AST, *types) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, types):
+                return anc
+        return None
+
+    def enclosing_function(self, node: ast.AST):
+        return self.enclosing(node, ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        return self.enclosing(node, ast.ClassDef)
+
+    def violation(self, rule: str, node: ast.AST, message: str) -> Violation:
+        line = getattr(node, "lineno", 1)
+        return Violation(rule=rule, path=self.rel, line=line,
+                         message=message, snippet=self.line_text(line))
+
+    def is_node_suppressed(self, rule: str, node: ast.AST,
+                           *extra_nodes: ast.AST) -> bool:
+        """Suppression may sit on the flagged line or on the first line
+        of any enclosing `with` / `try` / statement header."""
+        lines = [getattr(node, "lineno", 0)]
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.With, ast.Try, ast.stmt)):
+                lines.append(getattr(anc, "lineno", 0))
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        for n in extra_nodes:
+            lines.append(getattr(n, "lineno", 0))
+        return self.suppressed(rule, *lines)
+
+
+# --------------------------------------------------------------- ast helpers
+
+def call_name(node: ast.AST) -> str:
+    """Dotted name of a call target: ``ray.get`` / ``self._lock.acquire``.
+    Unresolvable pieces become ``?``."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        parts.append("()")
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def has_kw(call: ast.Call, *names: str) -> bool:
+    return any(kw.arg in names for kw in call.keywords)
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<unparse-failed>"
+
+
+def walk_calls(node: ast.AST) -> Iterable[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+# ------------------------------------------------------------------- project
+
+class Project:
+    """The linted file set plus lazily-built cross-file indices."""
+
+    def __init__(self, sources: List[Source]):
+        self.sources = sources
+        self.by_rel = {s.rel: s for s in sources}
+        self._lock_registry: Optional[Dict[str, dict]] = None
+
+    def control_plane(self) -> List[Source]:
+        return [s for s in self.sources if s.rel in CONTROL_PLANE]
+
+    def gang_paths(self) -> List[Source]:
+        return [s for s in self.sources if s.rel in GANG_PATHS]
+
+    # ---- lock registry: every `x = threading.Lock()/RLock()/...` site
+
+    _LOCK_CTORS = {"Lock": False, "RLock": True, "Condition": True,
+                   "Semaphore": False, "BoundedSemaphore": False}
+
+    def lock_registry(self) -> Dict[str, dict]:
+        """lock_id -> {"reentrant": bool, "source": rel, "line": int,
+        "attr": short name}. lock_id is ``module.Class._attr`` for
+        instance locks, ``module._name`` for module/local locks."""
+        if self._lock_registry is None:
+            reg: Dict[str, dict] = {}
+            for src in self.sources:
+                for node in ast.walk(src.tree):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    val = node.value
+                    if not isinstance(val, ast.Call):
+                        continue
+                    ctor = call_name(val).rsplit(".", 1)[-1]
+                    if ctor not in self._LOCK_CTORS:
+                        continue
+                    # Condition() wraps an RLock; Condition(lock) wraps
+                    # that lock — either way the with-block is reentrant
+                    # only if the underlying lock is.
+                    reentrant = self._LOCK_CTORS[ctor]
+                    for tgt in node.targets:
+                        text = unparse(tgt)
+                        if text.startswith("self."):
+                            cls = src.enclosing_class(node)
+                            cname = cls.name if cls else "?"
+                            lid = f"{src.modname}.{cname}.{text[5:]}"
+                            attr = text[5:]
+                        else:
+                            lid = f"{src.modname}.{text}"
+                            attr = text
+                        reg[lid] = {"reentrant": reentrant,
+                                    "source": src.rel,
+                                    "line": node.lineno,
+                                    "attr": attr}
+            self._lock_registry = reg
+        return self._lock_registry
+
+    def resolve_lock(self, src: Source, expr: ast.AST,
+                     ctx_node: ast.AST) -> Optional[str]:
+        """Map a with-item context expression to a registered lock id,
+        or a heuristic id when the name smells like a lock but has no
+        registered creation site. None = not a lock."""
+        reg = self.lock_registry()
+        text = unparse(expr)
+        if text.startswith("self."):
+            cls = src.enclosing_class(ctx_node)
+            if cls is not None:
+                lid = f"{src.modname}.{cls.name}.{text[5:]}"
+                if lid in reg:
+                    return lid
+        if isinstance(expr, ast.Name):
+            lid = f"{src.modname}.{text}"
+            if lid in reg:
+                return lid
+        if isinstance(expr, ast.Attribute):
+            # `other._lock`: match by attribute name across classes; an
+            # ambiguous attr maps to every class that defines it being
+            # conflated — acceptable for a linter, precise enough here.
+            matches = [lid for lid, info in reg.items()
+                       if info["attr"] == expr.attr]
+            if len(matches) == 1:
+                return matches[0]
+            if matches:
+                return f"?.{expr.attr}"
+        low = text.lower()
+        if "lock" in low or low.endswith("_cv") or low in ("cv", "cond"):
+            return f"{src.modname}:{text}"
+        return None
+
+    def lock_is_reentrant(self, lock_id: str) -> bool:
+        info = self.lock_registry().get(lock_id)
+        return bool(info and info["reentrant"])
+
+
+# ----------------------------------------------------------------- discovery
+
+_EXCLUDE_DIRS = {"__pycache__", "lint"}
+
+
+def collect_sources(paths: Optional[Sequence[str]] = None,
+                    root: str = REPO_ROOT) -> List[Source]:
+    """Parse every .py under ``paths`` (default: the ray_tpu package).
+    The linter does not lint itself (its fixtures would trip it)."""
+    files: List[str] = []
+    for p in (paths or [os.path.join(root, "ray_tpu")]):
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _EXCLUDE_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    files.append(os.path.join(dirpath, fn))
+    sources = []
+    for f in files:
+        rel = os.path.relpath(f, root).replace(os.sep, "/")
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                text = fh.read()
+            sources.append(Source(f, rel, text))
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+    return sources
+
+
+# -------------------------------------------------------------------- runner
+
+def all_checkers():
+    from ray_tpu._private.lint.checkers import (
+        blocking_under_lock,
+        config_drift,
+        exception_swallow,
+        hold_release,
+        lock_order,
+        unbounded_wait,
+    )
+    return [unbounded_wait, blocking_under_lock, lock_order,
+            hold_release, exception_swallow, config_drift]
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             root: str = REPO_ROOT,
+             rules: Optional[Set[str]] = None) -> List[Violation]:
+    project = Project(collect_sources(paths, root=root))
+    violations: List[Violation] = []
+    for checker in all_checkers():
+        if rules and checker.RULE not in rules:
+            continue
+        violations.extend(checker.check_project(project))
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations
+
+
+# ------------------------------------------------------------------ baseline
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, int]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            blob = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {str(k): int(v) for k, v in blob.get("entries", {}).items()}
+
+
+def save_baseline(violations: Iterable[Violation],
+                  path: str = DEFAULT_BASELINE) -> None:
+    entries: Dict[str, int] = {}
+    for v in violations:
+        entries[v.key] = entries.get(v.key, 0) + 1
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "comment": "raylint debt ratchet: counts may only "
+                              "decrease. Regenerate with "
+                              "`python -m ray_tpu._private.lint "
+                              "--write-baseline` AFTER fixing, never to "
+                              "absorb a new violation.",
+                   "entries": dict(sorted(entries.items()))},
+                  f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def diff_baseline(violations: List[Violation],
+                  baseline: Dict[str, int]
+                  ) -> Tuple[List[Violation], List[str]]:
+    """Returns (new_violations, stale_baseline_keys). The ratchet fails
+    on either: new debt is a regression; stale entries mean a fix landed
+    without shrinking the baseline (run --write-baseline)."""
+    counts: Dict[str, int] = {}
+    new: List[Violation] = []
+    for v in violations:
+        counts[v.key] = counts.get(v.key, 0) + 1
+        if counts[v.key] > baseline.get(v.key, 0):
+            new.append(v)
+    stale = [k for k, n in baseline.items() if counts.get(k, 0) < n]
+    return new, stale

@@ -235,7 +235,7 @@ class NodeManager:
             "is_head": is_head,
             "local_held": self._local_held.to_dict(),
             "local_held_seq": self._local_held_seq,
-        })
+        }, timeout=float(config.gcs_rpc_timeout_s))
         # Rejoin a restarted GCS (reference: raylet re-registration after
         # GCS failover): on conn drop, redial the same address and
         # re-register with a re-report of live actors + store contents.
@@ -759,6 +759,10 @@ class NodeManager:
         classic spawn (zygote still starting, or dead)."""
         if self._zygote is None or self._zygote.poll() is not None:
             return None
+        # raylint: disable-next=blocking-under-lock (this lock EXISTS to
+        # serialize the one fork conversation on the zygote socket —
+        # every waiter wants exactly this IO, and the socket carries a
+        # 10s settimeout so a dead zygote cannot wedge spawners)
         with self._zygote_lock:
             try:
                 if self._zygote_io is None:

@@ -53,6 +53,9 @@ def _recv_exact(sock: socket.socket, n: int, into: Optional[memoryview] = None):
     got = 0
     while got < n:
         try:
+            # raylint: disable-next=unbounded-wait (dedicated reader
+            # thread: blocking forever between frames IS the job; exit
+            # is conn close, which aborts the recv with an OSError)
             k = sock.recv_into(buf[got:], n - got)
         except (ConnectionResetError, OSError):
             raise ConnectionClosed()
@@ -204,6 +207,8 @@ class Conn:
 
     def _write_loop_inner(self):
         while True:
+            # raylint: disable-next=unbounded-wait (dedicated writer
+            # thread parked for work; close() sets the event to wake it)
             self._send_ev.wait()
             while True:
                 if not self._send_q:
@@ -212,6 +217,10 @@ class Conn:
                 # fast-path sender (_send) that just pushed a partial
                 # frame's remainder to the front must see it go out
                 # before anything else, and frames must never interleave.
+                # raylint: disable-next=blocking-under-lock (the write
+                # lock serializes frame bytes on the wire; the inline
+                # fast path only ever tries acquire(False), so no
+                # handler thread can block behind this sendall)
                 with self._write_lock:
                     if not self._send_q:
                         break
@@ -255,6 +264,7 @@ class Conn:
     def request_nowait(self, mtype: str, payload: Any = None) -> "_Future":
         fut = _Future()
         msg_id = self._alloc_id()
+        fut.msg_id = msg_id
         with self._pending_lock:
             self._pending[msg_id] = fut
         try:
@@ -267,7 +277,18 @@ class Conn:
 
     def request(self, mtype: str, payload: Any = None,
                 timeout: Optional[float] = None) -> Any:
-        return self.request_nowait(mtype, payload).result(timeout)
+        fut = self.request_nowait(mtype, payload)
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            # Abandon the pending slot: with control RPCs bounded by
+            # default (gcs_rpc_timeout_s), timeouts are a routine path —
+            # leaving the future registered would leak an entry per
+            # timed-out request for the life of the conn, and a late
+            # reply would resolve into a future nobody holds.
+            with self._pending_lock:
+                self._pending.pop(fut.msg_id, None)
+            raise
 
     def reply(self, to_msg_id: int, payload: Any = None) -> None:
         self._send(self._alloc_id(), to_msg_id, "reply", payload)
@@ -345,7 +366,7 @@ class Conn:
 
 
 class _Future:
-    __slots__ = ("_ev", "_value", "_error", "_cbs", "_cb_lock")
+    __slots__ = ("_ev", "_value", "_error", "_cbs", "_cb_lock", "msg_id")
 
     def __init__(self):
         self._ev = threading.Event()
@@ -353,6 +374,7 @@ class _Future:
         self._error = None
         self._cbs: list = []
         self._cb_lock = threading.Lock()
+        self.msg_id: Optional[int] = None  # set by request_nowait
 
     def set(self, value):
         self._value = value
@@ -424,6 +446,8 @@ class Server:
     def _accept_loop(self):
         while not self._closed:
             try:
+                # raylint: disable-next=unbounded-wait (dedicated accept
+                # thread; close() shuts the socket down to unblock it)
                 client, _ = self._sock.accept()
             except OSError:
                 break

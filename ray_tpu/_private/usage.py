@@ -27,8 +27,11 @@ _pending_features: Dict[str, str] = {}
 
 
 def usage_stats_enabled() -> bool:
-    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") not in (
-        "0", "false", "False")
+    from ray_tpu._private.config import config
+
+    # refresh: the opt-out env is documented to work whenever it is set,
+    # including programmatically between import and the first report.
+    return bool(config.refresh_from_env("usage_stats_enabled"))
 
 
 def _kv():

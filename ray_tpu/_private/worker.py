@@ -31,6 +31,7 @@ import cloudpickle
 
 from ray_tpu import exceptions
 from ray_tpu._private import device_objects, protocol, serialization
+from ray_tpu._private.config import config
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu._private.task_spec import (
     ActorCreationSpec,
@@ -328,6 +329,10 @@ class _GcsChannel:
         self._register_payload = payload
 
     def _reconnect(self, dead_conn) -> protocol.Conn:
+        # raylint: disable-next=blocking-under-lock (the redial lock:
+        # every thread queued on it needs the very conn this dial is
+        # establishing, and both the connect and the re-register carry
+        # explicit 30s bounds)
         with self._lock:
             if self._closed:
                 raise protocol.ConnectionClosed()
@@ -351,8 +356,21 @@ class _GcsChannel:
             conn2 = self._reconnect(conn)
             return getattr(conn2, fn_name)(*args, **kwargs)
 
-    def request(self, *args, **kwargs):
-        return self._call("request", *args, **kwargs)
+    # Explicit opt-out from the default RPC bound, for requests the GCS
+    # deliberately parks server-side (wait_for_objects with no user
+    # deadline): the wait is the user's contract, not a wedged peer.
+    UNBOUNDED = float("inf")
+
+    def request(self, mtype, payload=None, timeout=None):
+        """Control RPC with a bound by default: ``timeout=None`` means
+        ``config.gcs_rpc_timeout_s`` (a wedged GCS surfaces as
+        TimeoutError, not a parked control thread), ``UNBOUNDED`` opts
+        out for server-parked waits."""
+        if timeout is None:
+            timeout = float(config.gcs_rpc_timeout_s)
+        elif timeout == self.UNBOUNDED:
+            timeout = None
+        return self._call("request", mtype, payload, timeout=timeout)
 
     def request_nowait(self, *args, **kwargs):
         return self._call("request_nowait", *args, **kwargs)
@@ -423,6 +441,8 @@ class CoreWorker:
         self.store = plasma.PlasmaClient(store_path)
         # Workers know their node manager from the spawn env; drivers
         # resolve it once via the nodes table (lazy).
+        # raylint: disable-next=config-knob-drift (bootstrap identity:
+        # set per-process by the spawning NM, not a tunable knob)
         self._nm_address_cache: Optional[str] = \
             os.environ.get("RAY_TPU_NM_ADDRESS") or None
         # Create-backpressure: on a full store, ask our node manager to
@@ -685,11 +705,14 @@ class CoreWorker:
             t = None
             if deadline is not None:
                 t = max(0.0, deadline - time.time())
+            # Server-parked wait: with no user deadline the GCS holds
+            # the reply until the objects land — unbounded is the
+            # get()-with-no-timeout user contract, not a wedged peer.
             reply = self.gcs.request("wait_for_objects", {
                 "object_ids": list(pending),
                 "num_returns": len(pending),
                 "timeout": t,
-            })
+            }, timeout=self.gcs.UNBOUNDED if t is None else t + 30.0)
             if reply.get("timeout"):
                 raise exceptions.GetTimeoutError(
                     f"{len(pending)} object(s) not ready within timeout")
@@ -945,11 +968,14 @@ class CoreWorker:
                             and ent.get("info") is not None:
                         ready_set.add(o)
         if len(ready_set) < num_returns:
+            # Server-parked wait (see _wait_missing): unbounded only
+            # when the caller passed no timeout — wait()'s contract.
             reply = self.gcs.request("wait_for_objects", {
                 "object_ids": [o for o in ids if o not in ready_set],
                 "num_returns": num_returns - len(ready_set),
                 "timeout": timeout if timeout is not None else None,
-            })
+            }, timeout=self.gcs.UNBOUNDED if timeout is None
+                else timeout + 30.0)
             ready_set.update(reply["ready"])
             ready_set.update(reply.get("failed") or {})
         ready, not_ready = [], []
@@ -1329,9 +1355,14 @@ class CoreWorker:
 
     def resolve_actor_blocking(self, actor_id: ActorID,
                                timeout: Optional[float] = None) -> dict:
+        # Server-parked wait: the GCS holds the reply while the actor is
+        # PENDING/RESTARTING. timeout=None is this method's documented
+        # "block until resolved" — map it to the explicit UNBOUNDED
+        # sentinel, not the channel's default bound.
         return self.gcs.request("resolve_actor",
                                 {"actor_id": actor_id.binary()},
-                                timeout=timeout)
+                                timeout=self.gcs.UNBOUNDED
+                                if timeout is None else timeout)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         with self._actor_lock:
@@ -1371,24 +1402,35 @@ class KvClient:
     def __init__(self, gcs_conn):
         self._gcs = gcs_conn
 
+    def _rpc_timeout(self) -> float:
+        # Explicit per-call bound: KvClient also works over a raw
+        # protocol.Conn (no channel-side default), so every KV RPC
+        # states its own.
+        return float(config.gcs_rpc_timeout_s)
+
     def put(self, key: bytes, value: bytes, overwrite: bool = True,
             namespace: str = "") -> bool:
         return self._gcs.request("kv_put", {
             "ns": namespace, "key": key, "value": value,
-            "overwrite": overwrite})
+            "overwrite": overwrite}, timeout=self._rpc_timeout())
 
     def get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
-        return self._gcs.request("kv_get", {"ns": namespace, "key": key})
+        return self._gcs.request("kv_get", {"ns": namespace, "key": key},
+                                 timeout=self._rpc_timeout())
 
     def delete(self, key: bytes, namespace: str = "") -> bool:
-        return self._gcs.request("kv_del", {"ns": namespace, "key": key})
+        return self._gcs.request("kv_del", {"ns": namespace, "key": key},
+                                 timeout=self._rpc_timeout())
 
     def exists(self, key: bytes, namespace: str = "") -> bool:
-        return self._gcs.request("kv_exists", {"ns": namespace, "key": key})
+        return self._gcs.request("kv_exists",
+                                 {"ns": namespace, "key": key},
+                                 timeout=self._rpc_timeout())
 
     def keys(self, prefix: bytes = b"", namespace: str = "") -> List[bytes]:
         return self._gcs.request("kv_keys", {"ns": namespace,
-                                             "prefix": prefix})
+                                             "prefix": prefix},
+                                 timeout=self._rpc_timeout())
 
 
 def _error_from_reason(reason: Optional[str]) -> BaseException:
@@ -1507,7 +1549,9 @@ def init(address=None, num_cpus=None, num_tpus=None, resources=None,
             gcs_address = _global_cluster.address
         else:
             if address == "auto":
-                address = os.environ.get("RAY_TPU_ADDRESS")
+                # refresh: 'auto' historically honored RAY_TPU_ADDRESS
+                # set after import (programmatic exports before init).
+                address = config.refresh_from_env("address")
                 if not address:
                     raise ConnectionError(
                         "address='auto' but RAY_TPU_ADDRESS is not set")

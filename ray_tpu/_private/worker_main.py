@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional
 
 from ray_tpu import exceptions
 from ray_tpu._private import device_objects, protocol, serialization
+from ray_tpu._private.config import config
 from ray_tpu._private.ids import ActorID, JobID, TaskID
 from ray_tpu._private.task_spec import ActorCreationSpec, ActorTaskSpec, TaskSpec
 from ray_tpu._private.worker import CoreWorker, set_global_worker
@@ -81,6 +82,9 @@ class WorkerExecutor:
         # trip vs loopback TCP (measured ~200us -> ~100us) — this is the
         # per-task steady-state path, so the saving lands on every task.
         self.direct_ux = None
+        # raylint: disable-next=config-knob-drift (bootstrap identity:
+        # per-worker spawn env from the NM, may differ from the value
+        # the config module snapshotted at zygote import)
         session_dir = os.environ.get("RAY_TPU_SESSION_DIR")
         if session_dir:
             try:
@@ -94,7 +98,11 @@ class WorkerExecutor:
         self.nm = protocol.connect(nm_address, handler=self._on_msg,
                                    name="worker-nm")
         self.nm.on_close = lambda conn: self._on_nm_closed()
-        reply = self.nm.request("register_worker", {
+        # Bounded by the same budget the NM's reaper applies to us: a
+        # worker that cannot register within it will be killed anyway,
+        # so exit cleanly instead of parking on a wedged NM.
+        reply = self.nm.request("register_worker", timeout=float(
+            config.worker_start_timeout_s), payload={
             "worker_id": worker_id, "pid": os.getpid(),
             "direct_address": self.direct.address,
             "direct_address_ux": (self.direct_ux.address
@@ -216,6 +224,9 @@ class WorkerExecutor:
         while True:
             with self._cv:
                 while self._running and not self._queue:
+                    # raylint: disable-next=unbounded-wait (the worker
+                    # main loop parked for its next task; "exit" and
+                    # conn-close both notify the cv to unpark it)
                     self._cv.wait()
                 if not self._running:
                     break
@@ -462,6 +473,8 @@ class WorkerExecutor:
             # by-reference pickles resolve. Isolated workers skip this —
             # driver-local dirs must never shadow their pinned
             # working_dir / py_modules snapshot.
+            # raylint: disable-next=config-knob-drift (bootstrap
+            # identity: per-worker isolation flag set at spawn)
             if not os.environ.get("RAY_TPU_ISOLATED_ENV"):
                 for p in reversed(spec.sys_path or []):
                     if p not in sys.path:
@@ -690,10 +703,19 @@ def main():
     import faulthandler
 
     faulthandler.register(signal.SIGUSR2, all_threads=True)
+    # Bootstrap identity, not knobs: the spawning NM writes these into
+    # the child env AFTER the config module may already have been
+    # imported (zygote fork), so the typed registry would serve stale
+    # values — the raw read is the correct one here.
+    # raylint: disable-next=config-knob-drift (bootstrap identity)
     worker_id = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])
+    # raylint: disable-next=config-knob-drift (bootstrap identity)
     nm_address = os.environ["RAY_TPU_NM_ADDRESS"]
+    # raylint: disable-next=config-knob-drift (bootstrap identity)
     gcs_address = os.environ["RAY_TPU_GCS_ADDRESS"]
+    # raylint: disable-next=config-knob-drift (bootstrap identity)
     store_path = os.environ["RAY_TPU_STORE_PATH"]
+    # raylint: disable-next=config-knob-drift (bootstrap identity)
     node_id = os.environ["RAY_TPU_NODE_ID"]
 
     try:

@@ -58,7 +58,11 @@ def _ensure_built() -> str:
     used by benchmarks/run_tsan_store.sh to load an instrumented build
     from a temp dir without touching the tracked artifact.
     """
-    override = os.environ.get("RAY_TPU_STORE_SO")
+    from ray_tpu._private.config import config
+
+    # refresh: the sanitizer harnesses export RAY_TPU_STORE_SO for a
+    # child process whose config module may predate the export.
+    override = config.refresh_from_env("store_so")
     if override:
         return override
     with _build_lock:

@@ -26,12 +26,16 @@ The ``BaseGroup`` plug-point mirrors
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from enum import Enum
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
+
+
+logger = logging.getLogger("ray_tpu.collective")
 
 
 class ReduceOp(Enum):
@@ -405,7 +409,13 @@ class StoreGroup(BaseGroup):
             try:
                 self._coord = coord_cls.options(
                     name=name, lifetime="detached").remote(world_size)
-            except Exception:
+            except Exception as e:
+                # Lost the create race (re-formed gang, parallel rank 0):
+                # attach to the winner. get_actor raising here (the
+                # failure was NOT a name race) is the real error — let
+                # it propagate.
+                logger.debug("coordinator create for %s raced (%s); "
+                             "attaching to the existing actor", name, e)
                 self._coord = ray_tpu.get_actor(name)
         else:
             deadline = time.time() + self._rendezvous_timeout_s
@@ -476,7 +486,11 @@ class StoreGroup(BaseGroup):
             try:
                 self._on_poisoned_wedged()
             except Exception:
-                pass
+                # The wedge-teardown is the LAST unwedge lever for ranks
+                # stuck in a compiled collective — if it failed, say so.
+                logger.warning("poison-wedge teardown failed; survivors "
+                               "may stay blocked until the op deadline",
+                               exc_info=True)
 
     # Every coordinator round-trip is bounded and retried: a single lost
     # RPC (e.g. a submission dropped in an ack/re-park race) must degrade
@@ -610,6 +624,10 @@ class StoreGroup(BaseGroup):
         if self.rank == 0:
             try:
                 ray_tpu.kill(self._coord)
+            # raylint: disable-next=exception-swallow (best-effort reap
+            # on the deliberate-destroy path: the coordinator being
+            # already dead — gang death — is the expected failure here,
+            # and destroy() must never fail a teardown)
             except Exception:
                 pass
 
@@ -673,6 +691,9 @@ def join_world(coordinator_address: str, world_size: int, rank: int,
             # multi-process meshes). Must be set before the backend
             # client exists; a no-op on TPU.
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # raylint: disable-next=exception-swallow (compat shim: only
+        # raises on jax versions that lack this config knob, where the
+        # default is already correct; no gang error can originate here)
         except Exception:
             pass
         jax.distributed.initialize(
@@ -843,7 +864,9 @@ class XlaDistributedGroup(StoreGroup):
             if client is not None:
                 client.shutdown()
         except Exception:
-            pass
+            logger.warning("jax.distributed world teardown failed; a "
+                           "rank wedged in a compiled collective may "
+                           "stay blocked", exc_info=True)
 
     # -- collectives (single tensor in / single tensor out, like StoreGroup)
 
@@ -973,7 +996,12 @@ def poison_group(group_name: str, reason: str,
         coord = ray_tpu.get_actor(_COORD_NAME_FMT.format(group_name))
         ray_tpu.get(coord.poison.remote(reason), timeout=timeout_s)
         return True
-    except Exception:
+    except Exception as e:
+        # Propagated by contract through the return value: False means
+        # the coordinator is unreachable (its node died), and members
+        # detect THAT case through their own watchers.
+        logger.debug("poison_group(%s) could not reach the "
+                     "coordinator: %s", group_name, e)
         return False
 
 
@@ -1020,4 +1048,7 @@ def send(tensor, dst_rank: int, group_name: str = DEFAULT_GROUP_NAME):
 
 
 def recv(shape, dtype, src_rank: int, group_name: str = DEFAULT_GROUP_NAME):
+    # raylint: disable-next=unbounded-wait (collective recv, not a
+    # socket: bounded internally by RAY_TPU_COLLECTIVE_OP_TIMEOUT_S and
+    # unwedged early by the group's poison watcher)
     return get_group(group_name).recv(shape, dtype, src_rank)

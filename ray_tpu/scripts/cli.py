@@ -26,7 +26,9 @@ _ADDR_FILE = "/tmp/ray_tpu_head.addr"
 def _connect(address: str | None):
     import ray_tpu
 
-    addr = address or os.environ.get("RAY_TPU_ADDRESS")
+    from ray_tpu._private.config import config
+
+    addr = address or config.refresh_from_env("address")
     if not addr and os.path.exists(_ADDR_FILE):
         addr = open(_ADDR_FILE).read().strip()
     if not addr:
@@ -201,7 +203,9 @@ def cmd_stack(args) -> int:
     import ray_tpu
     from ray_tpu._private import worker as worker_mod
 
-    addr = args.address or os.environ.get("RAY_TPU_ADDRESS")
+    from ray_tpu._private.config import config
+
+    addr = args.address or config.refresh_from_env("address")
     if not addr and os.path.exists(_ADDR_FILE):
         addr = open(_ADDR_FILE).read().strip()
     if not addr:

@@ -146,6 +146,9 @@ class DataParallelTrainer:
                 if gang_death:
                     try:
                         _metrics()["restarts"].inc()
+                    # raylint: disable-next=exception-swallow (metrics
+                    # best-effort by contract; the restart below is the
+                    # load-bearing step and must always proceed)
                     except Exception:
                         pass
                 delay = min(backoff * (2 ** (num_restarts - 1)),

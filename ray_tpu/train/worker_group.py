@@ -11,7 +11,9 @@ placement group so a multi-chip mesh lands on one ICI domain
 
 from __future__ import annotations
 
+import logging
 import os
+import queue as queue_mod
 import threading
 import time
 import traceback
@@ -27,6 +29,8 @@ from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.util.placement_group import (
     placement_group, remove_placement_group,
 )
+
+logger = logging.getLogger("ray_tpu.train.gang")
 
 # Gang fault-tolerance metrics (ride the process's metrics reporter to
 # the GCS metrics table, rendered by the dashboard's /metrics — the same
@@ -155,6 +159,10 @@ class TrainWorker:
         try:
             if collective.is_group_initialized(self.group_name):
                 collective.destroy_collective_group(self.group_name)
+        # raylint: disable-next=exception-swallow (teardown path: a
+        # GangMemberDiedError here means the group we are destroying is
+        # already dead — the very condition teardown handles; the
+        # session shutdown below must still run)
         except Exception:
             pass
         session_mod._shutdown_session()
@@ -259,6 +267,9 @@ class WorkerGroup:
         try:
             _metrics()["detect"].observe(max(
                 0.0, time.time() - self._last_alive.get(rank, time.time())))
+        # raylint: disable-next=exception-swallow (metrics are
+        # best-effort by contract: an unreachable reporter must never
+        # block the poison call below — that is the load-bearing step)
         except Exception:
             pass
         self.poison(f"rank {rank} died: {reason}", rank=rank)
@@ -278,6 +289,8 @@ class WorkerGroup:
 
         try:
             _metrics()["poisoned"].inc()
+        # raylint: disable-next=exception-swallow (metrics best-effort
+        # by contract; the poison_group call below must always run)
         except Exception:
             pass
         collective.poison_group(self.group_name, reason)
@@ -295,7 +308,9 @@ class WorkerGroup:
 
             sub = pubsub.subscribe("actor_state")
         except Exception:
-            pass
+            logger.debug("actor_state pubsub unavailable; death "
+                         "detection falls back to liveness pings only",
+                         exc_info=True)
         misses = {rank: 0 for rank in range(self.num_workers)}
         try:
             while not self._stop.wait(self._heartbeat_s):
@@ -304,8 +319,8 @@ class WorkerGroup:
                 while sub is not None:
                     try:
                         msg = sub.get_nowait()
-                    except Exception:
-                        break
+                    except queue_mod.Empty:
+                        break  # drained this round
                     try:
                         rank = self._actor_ids.get(msg.get("actor_id"))
                         if rank is not None and msg.get("state") == "DEAD":
@@ -313,7 +328,11 @@ class WorkerGroup:
                                 rank,
                                 msg.get("death_cause") or "actor died")
                     except Exception:
-                        pass
+                        # A malformed death notification must not be
+                        # dropped in silence — the ping path will still
+                        # catch the dead rank, but ~30x slower.
+                        logger.warning("dropped a malformed actor_state "
+                                       "death notification", exc_info=True)
                 # 2) Bounded liveness pings (catches wedged-alive ranks
                 #    and runs even when pubsub is unavailable). Submit
                 #    all pings first so one slow rank doesn't stretch
@@ -349,12 +368,18 @@ class WorkerGroup:
             if sub is not None:
                 try:
                     sub.unsubscribe()
+                # raylint: disable-next=exception-swallow (supervisor
+                # exit cleanup: nothing downstream consumes this sub,
+                # and the supervisor must not die un-unsubscribed-ly)
                 except Exception:
                     pass
 
-    def start(self, train_fn: Callable, config: Optional[dict],
+    def start(self, train_fn: Callable, run_config: Optional[dict],
               checkpoint: Optional[Checkpoint],
               datasets: Optional[Dict[str, Any]] = None):
+        # (named run_config, not config: every caller passes it
+        # positionally, and shadowing the config-registry module here
+        # is exactly how the timeout below would silently break)
         blob = cloudpickle.dumps(train_fn)
         path = checkpoint.path if checkpoint is not None else None
         # Shard each dataset lazily by blocks: every rank executes only
@@ -365,8 +390,18 @@ class WorkerGroup:
                      for name, ds in datasets.items()}
             per_rank = [{name: shards[r] for name, shards in split.items()}
                         for r in range(self.num_workers)]
-        ray_tpu.get([w.start.remote(blob, config, path, per_rank[i])
-                     for i, w in enumerate(self.workers)])
+        # Gang formation step: a rank that cannot ack start() is wedged
+        # — fail fit()'s attempt (and let the restart path re-form)
+        # instead of parking forever. The margin matches setup's
+        # deliberate 4x-rendezvous + 60s: start() also unpickles the
+        # train-fn blob and the per-rank dataset shard handles, and a
+        # deterministically-slow-but-healthy start must NOT become an
+        # unwinnable restart loop.
+        ray_tpu.get(
+            [w.start.remote(blob, run_config, path, per_rank[i])
+             for i, w in enumerate(self.workers)],
+            timeout=4 * float(config.collective_rendezvous_timeout_s)
+            + 60.0)
 
     def poll(self) -> List[Dict[str, Any]]:
         """Drain every rank's reports with per-worker error isolation: a
@@ -419,11 +454,16 @@ class WorkerGroup:
             try:
                 ray_tpu.get([w.teardown.remote() for w in self.workers],
                             timeout=10)
+            # raylint: disable-next=exception-swallow (cooperative
+            # teardown is advisory: dead/wedged ranks are expected here
+            # and the unconditional SIGKILL below is the real teardown)
             except Exception:
                 pass
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
+            # raylint: disable-next=exception-swallow (force-kill of a
+            # possibly-already-dead actor: the error IS the goal state)
             except Exception:
                 pass
         # The group coordinator is a detached named actor: rank 0 kills it
@@ -435,10 +475,16 @@ class WorkerGroup:
             coord = ray_tpu.get_actor(
                 collective._COORD_NAME_FMT.format(self.group_name))
             ray_tpu.kill(coord)
+        # raylint: disable-next=exception-swallow (coordinator reap:
+        # "no such actor" — rank 0 already killed it on the graceful
+        # path — is the common, correct outcome)
         except Exception:
             pass
         if self._owns_pg:
             try:
                 remove_placement_group(self.pg)
+            # raylint: disable-next=exception-swallow (best-effort PG
+            # cleanup on teardown; a re-formed gang allocates a fresh
+            # PG regardless, and leaked PGs die with the job)
             except Exception:
                 pass

@@ -55,8 +55,12 @@ class PlacementGroup:
     def wait(self, timeout_seconds: Optional[float] = 30) -> bool:
         core = worker_mod.require_worker()
         try:
+            # Server-parked wait (GCS holds the reply until the PG is
+            # CREATED): None means wait() 's documented "no deadline",
+            # not the channel's default RPC bound.
             core.gcs.request("wait_pg_ready", {"pg_id": self.id.binary()},
-                             timeout=timeout_seconds)
+                             timeout=core.gcs.UNBOUNDED
+                             if timeout_seconds is None else timeout_seconds)
             return True
         except TimeoutError:
             return False

@@ -33,6 +33,51 @@ def pytest_configure(config):
         "via -m 'not slow'")
 
 
+# Runtime lock-order witness (ray_tpu._private.lockdep): enabled for the
+# scheduler / gang / device-object modules — the control-plane surfaces
+# whose lock graphs raylint's static lock-order checker models. Once a
+# test from these modules installs it, it stays on for the rest of the
+# session (wrapping is creation-time, so coverage only grows); every
+# test teardown then asserts no ordering cycle was witnessed.
+LOCKDEP_MODULES = {
+    "test_local_scheduler",
+    "test_gang_fault_tolerance",
+    "test_device_objects",
+}
+
+
+def _lockdep_env_enabled() -> bool:
+    # Same truthiness vocabulary as the config registry's bool coercion:
+    # RAY_TPU_LOCKDEP_ENABLED=0 must mean OFF, not "set, therefore on".
+    return os.environ.get("RAY_TPU_LOCKDEP_ENABLED", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def pytest_runtest_setup(item):
+    mod = getattr(item.module, "__name__", "")
+    if mod in LOCKDEP_MODULES or _lockdep_env_enabled():
+        from ray_tpu._private import lockdep
+
+        lockdep.install()
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_cycle_guard():
+    """Assert no lock-order cycle was witnessed during the test. A
+    fixture finalizer (NOT a raising pytest_runtest_teardown hook): a
+    hook exception aborts the SetupState unwind and poisons the NEXT
+    test's setup with 'previous item was not torn down properly'."""
+    yield
+    from ray_tpu._private import lockdep
+
+    if lockdep.installed():
+        found = lockdep.take_violations()
+        if found:
+            pytest.fail(
+                "lockdep witnessed a lock-order cycle during this test:\n"
+                + "\n".join(str(v) for v in found), pytrace=False)
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu

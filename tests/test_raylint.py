@@ -1,0 +1,710 @@
+"""raylint + lockdep tier-1 tests.
+
+Three layers:
+- fixture snippets per checker: minimal must-trigger and
+  must-not-trigger cases, including the historical r7 findings
+  reconstructed as fixtures (so the checkers that encode them regress
+  loudly);
+- the repo itself: zero non-baselined violations, and the ratchet
+  failing on a seeded violation / a stale baseline entry;
+- the runtime lockdep shim: a constructed AB/BA deadlock must be
+  witnessed with the cycle reported.
+
+Pure ``ast`` + threading — no jax, no cluster.
+"""
+
+import json
+import threading
+
+import pytest
+
+from ray_tpu._private import lockdep
+from ray_tpu._private.lint import core
+
+
+def lint_tree(tmp_path, files, rules=None):
+    """Write {relpath: source} under tmp_path and lint it as if it were
+    the repo root."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return core.run_lint([str(tmp_path / "ray_tpu")], root=str(tmp_path),
+                         rules=rules)
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+NM = "ray_tpu/_private/node_manager.py"   # a control-plane path
+COLL = "ray_tpu/parallel/collective.py"   # a gang path
+
+
+# --------------------------------------------------------- unbounded-wait
+
+def test_unbounded_wait_triggers(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import ray\n"
+        "def supervisor(conn, ev, fut):\n"
+        "    ray.get(fut)\n"
+        "    conn.request('lease_worker', {})\n"
+        "    ev.wait()\n"
+        "    fut.result()\n"
+    )})
+    waits = [x for x in v if x.rule == "unbounded-wait"]
+    assert len(waits) == 4, v
+    assert {w.line for w in waits} == {3, 4, 5, 6}
+
+
+def test_unbounded_wait_bounded_calls_pass(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import ray\n"
+        "def supervisor(conn, ev, fut, t):\n"
+        "    ray.get(fut, timeout=5)\n"
+        "    conn.request('lease_worker', {}, timeout=t)\n"
+        "    ev.wait(1.0)\n"
+        "    fut.result(t)\n"
+        "    d = {}\n"
+        "    d.get('key')\n"          # dict.get: positional key, no wait
+    )})
+    assert [x for x in v if x.rule == "unbounded-wait"] == []
+
+
+def test_unbounded_wait_r7a_deferred_lease_reply(tmp_path):
+    # r7 finding (a), reconstructed: the caller awaited a deferred
+    # worker-lease reply with no bound — a worker that hung during
+    # startup wedged that shape's whole pipeline.
+    v = lint_tree(tmp_path, {"ray_tpu/_private/lease.py": (
+        "def _grant(self, shape):\n"
+        "    fut = self._conn.request_nowait('lease_worker', shape)\n"
+        "    return fut.result()\n"   # <- the hang
+    )})
+    assert rules_of(v) == ["unbounded-wait"]
+
+
+def test_unbounded_wait_ignores_non_control_plane(tmp_path):
+    v = lint_tree(tmp_path, {"ray_tpu/scripts/cli.py": (
+        "def main(fut):\n"
+        "    fut.result()\n"
+    )}, rules={"unbounded-wait"})
+    assert v == []
+
+
+# ---------------------------------------------------- blocking-under-lock
+
+def test_blocking_under_lock_direct_and_one_call_deep(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import subprocess, threading, time\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _spawn_worker(self):\n"
+        "        return subprocess.Popen(['true'])\n"
+        "    def bad_direct(self, conn):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)\n"
+        "            conn.request('x', timeout=5)\n"
+        "    def bad_via_helper(self):\n"
+        "        with self._lock:\n"
+        "            self._spawn_worker()\n"
+    )})
+    blocked = [x for x in v if x.rule == "blocking-under-lock"]
+    assert len(blocked) == 3, v
+    assert any("_spawn_worker" in x.message for x in blocked)
+
+
+def test_blocking_outside_lock_and_condition_idiom_pass(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import threading, time\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition()\n"
+        "    def ok(self):\n"
+        "        with self._lock:\n"
+        "            snapshot = 1\n"
+        "        time.sleep(0.1)\n"          # outside the lock
+        "    def ok_cv(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait(1.0)\n"   # releases while waiting
+    )}, rules={"blocking-under-lock"})
+    assert v == []
+
+
+# ----------------------------------------------------------- lock-order
+
+def test_lock_order_ab_ba_cycle(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import threading\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._spill_lock = threading.Lock()\n"
+        "    def ab(self):\n"
+        "        with self._lock:\n"
+        "            with self._spill_lock:\n"
+        "                pass\n"
+        "    def ba(self):\n"
+        "        with self._spill_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    )})
+    cycles = [x for x in v if x.rule == "lock-order"
+              and "cycle" in x.message]
+    assert len(cycles) == 1
+    assert "_lock" in cycles[0].message and "_spill_lock" in \
+        cycles[0].message
+
+
+def test_lock_order_consistent_nesting_passes(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import threading\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._spill_lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            with self._spill_lock:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._lock:\n"
+        "            with self._spill_lock:\n"
+        "                pass\n"
+    )}, rules={"lock-order"})
+    assert v == []
+
+
+def test_lock_order_nonreentrant_self_nest_via_helper(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import threading\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.helper()\n"
+        "    def helper(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )}, rules={"lock-order"})
+    assert len(v) == 1 and "re-acquired while held" in v[0].message
+
+
+def test_lock_order_rlock_self_nest_passes(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import threading\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.helper()\n"
+        "    def helper(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )}, rules={"lock-order"})
+    assert v == []
+
+
+# --------------------------------------------------------- hold-release
+
+R7C_LEAK = (
+    # r7 finding (c), reconstructed: _spawn_worker raising after the
+    # mirror-subtract leaked the hold; every failed spawn permanently
+    # shrank the node's schedulable capacity.
+    "class NodeManager:\n"
+    "    def _on_lease_task(self, spec):\n"
+    "        self._local_avail.subtract(spec.resources)\n"
+    "        w = self._spawn_worker()\n"
+    "        return w\n"
+)
+
+R7C_FIXED = (
+    # The attached[]-guard retrofit PR 3 landed, in miniature.
+    "class NodeManager:\n"
+    "    def _on_lease_task(self, spec):\n"
+    "        self._local_avail.subtract(spec.resources)\n"
+    "        try:\n"
+    "            w = self._spawn_worker()\n"
+    "        except BaseException:\n"
+    "            self._local_avail.release(spec.resources)\n"
+    "            raise\n"
+    "        return w\n"
+)
+
+
+def test_hold_release_r7c_leak_triggers(tmp_path):
+    v = lint_tree(tmp_path, {NM: R7C_LEAK})
+    holds = [x for x in v if x.rule == "hold-release"]
+    assert len(holds) == 1 and "local-ledger hold" in holds[0].message
+
+
+def test_hold_release_attached_guard_passes(tmp_path):
+    v = lint_tree(tmp_path, {NM: R7C_FIXED}, rules={"hold-release"})
+    assert v == []
+
+
+def test_hold_release_custody_transfer_passes(tmp_path):
+    # The sanctioned pattern: the hold is recorded in a *_held* registry
+    # whose owner (task-done / death path) releases it later.
+    v = lint_tree(tmp_path, {NM: (
+        "class NodeManager:\n"
+        "    def _on_lease_task(self, spec, tid):\n"
+        "        self._res_held_tasks[tid] = dict(spec.resources)\n"
+        "        self._local_avail.subtract(spec.resources)\n"
+        "        w = self._spawn_worker()\n"
+        "        return w\n"
+    )}, rules={"hold-release"})
+    assert v == []
+
+
+def test_hold_release_chip_leak_triggers(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "class NodeManager:\n"
+        "    def grab(self, k):\n"
+        "        chips = self._acquire_chips(k)\n"
+        "        if chips is None:\n"
+        "            raise RuntimeError('no chips')\n"
+        "        return chips\n"
+    )})
+    holds = [x for x in v if x.rule == "hold-release"]
+    assert len(holds) == 1 and "chip hold" in holds[0].message
+
+
+# ----------------------------------------------------- exception-swallow
+
+def test_exception_swallow_triggers_and_handled_passes(tmp_path):
+    v = lint_tree(tmp_path, {COLL: (
+        "import logging\n"
+        "logger = logging.getLogger('x')\n"
+        "def bad(coord):\n"
+        "    try:\n"
+        "        coord.poll()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "def ok_logged(coord):\n"
+        "    try:\n"
+        "        coord.poll()\n"
+        "    except Exception:\n"
+        "        logger.exception('poll failed')\n"
+        "def ok_reraise(coord):\n"
+        "    try:\n"
+        "        coord.poll()\n"
+        "    except Exception as e:\n"
+        "        if 'gang' in str(e):\n"
+        "            raise\n"
+    )}, rules={"exception-swallow"})
+    assert len(v) == 1 and v[0].line == 6
+
+
+def test_exception_swallow_not_applied_outside_gang_paths(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "def shutdown(w):\n"
+        "    try:\n"
+        "        w.proc.kill()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )}, rules={"exception-swallow"})
+    assert v == []
+
+
+# ---------------------------------------------------- config-knob-drift
+
+def test_config_drift_triggers_on_reads_not_writes(tmp_path):
+    v = lint_tree(tmp_path, {"ray_tpu/util/thing.py": (
+        "import os\n"
+        "a = os.environ.get('RAY_TPU_FOO')\n"
+        "b = os.getenv('RAY_TPU_BAR', '1')\n"
+        "c = os.environ['RAY_TPU_BAZ']\n"
+        "os.environ['RAY_TPU_CHILD_VAR'] = 'x'\n"   # write: spawner-side
+        "d = os.environ.get('OTHER_PREFIX')\n"       # not our namespace
+    )})
+    drift = [x for x in v if x.rule == "config-knob-drift"]
+    assert {x.line for x in drift} == {2, 3, 4}
+
+
+def test_config_drift_suppression_with_comment(tmp_path):
+    v = lint_tree(tmp_path, {"ray_tpu/util/thing.py": (
+        "import os\n"
+        "# raylint: disable-next=config-knob-drift (bootstrap identity)\n"
+        "a = os.environ.get('RAY_TPU_WORKER_ID')\n"
+    )})
+    assert v == []
+
+
+def test_suppression_spans_multiline_comment(tmp_path):
+    v = lint_tree(tmp_path, {"ray_tpu/util/thing.py": (
+        "import os\n"
+        "# raylint: disable-next=config-knob-drift (bootstrap\n"
+        "# identity: several comment lines between the directive\n"
+        "# and the statement it annotates)\n"
+        "a = os.environ.get('RAY_TPU_WORKER_ID')\n"
+    )})
+    assert v == []
+
+
+def test_bare_disable_without_rule_is_not_honored(tmp_path):
+    v = lint_tree(tmp_path, {"ray_tpu/util/thing.py": (
+        "import os\n"
+        "a = os.environ.get('RAY_TPU_FOO')  # raylint: disable\n"
+    )})
+    assert rules_of(v) == ["config-knob-drift"]
+
+
+# --------------------------------------------------- repo + the ratchet
+
+def test_repo_is_clean_against_baseline():
+    violations = core.run_lint()
+    baseline = core.load_baseline()
+    new, stale = core.diff_baseline(violations, baseline)
+    assert new == [], "\n".join(str(v) for v in new)
+    assert stale == [], stale
+
+
+def test_ratchet_fails_on_seeded_violation(tmp_path):
+    # Acceptance criterion: seed a ray.get without timeout into a
+    # supervisor path and the ratchet must fail against the baseline.
+    v = lint_tree(tmp_path, {NM: (
+        "import ray\n"
+        "def _supervisor_loop(fut):\n"
+        "    return ray.get(fut)\n"
+    )})
+    new, stale = core.diff_baseline(v, core.load_baseline())
+    assert len(new) == 1 and new[0].rule == "unbounded-wait"
+
+
+def test_ratchet_fails_on_stale_baseline_entry(tmp_path):
+    stale_baseline = {"unbounded-wait::ray_tpu/_private/gone.py::x = 1": 1}
+    new, stale = core.diff_baseline(core.run_lint(), stale_baseline)
+    assert stale == list(stale_baseline)
+
+
+def test_baseline_identity_survives_line_churn(tmp_path):
+    src = ("import ray\n"
+           "def f(fut):\n"
+           "    return ray.get(fut)\n")
+    v1 = lint_tree(tmp_path, {NM: src})
+    # same code shifted 10 lines down: same baseline key
+    shifted = ("\n" * 10) + src
+    v2 = lint_tree(tmp_path, {NM: shifted})
+    assert v1[0].key == v2[0].key
+    assert v1[0].line != v2[0].line
+
+
+def test_cli_repo_clean_and_explain(capsys):
+    # In-process (a fresh interpreter pays the environment's jax
+    # preimport; the CLI logic is identical through main()).
+    from ray_tpu._private.lint.__main__ import main
+
+    assert main([]) == 0, capsys.readouterr().out
+    capsys.readouterr()
+    assert main(["--explain", "blocking-under-lock"]) == 0
+    out = capsys.readouterr().out
+    assert "r7" in out and "MSG_DONTWAIT" in out
+    assert main(["--explain", "no-such-rule"]) == 2
+    assert main(["--list-rules"]) == 0
+    assert len(capsys.readouterr().out.strip().splitlines()) >= 6
+
+
+def test_cli_ratchet_fails_on_stale_baseline(tmp_path, capsys):
+    from ray_tpu._private.lint.__main__ import main
+
+    stale = tmp_path / "baseline.json"
+    stale.write_text(json.dumps({"version": 1, "entries": {
+        "unbounded-wait::ray_tpu/_private/gone.py::x = 1": 1}}))
+    assert main(["--baseline", str(stale)]) == 1
+    assert "STALE" in capsys.readouterr().out
+
+
+def test_every_rule_has_explain_text():
+    for checker in core.all_checkers():
+        assert checker.EXPLAIN.strip().startswith(checker.RULE)
+        assert "Fix:" in checker.EXPLAIN or "fix" in checker.EXPLAIN.lower()
+
+
+# ------------------------------- fixes surfaced by the initial sweep
+
+def test_gcs_channel_request_is_bounded_by_default():
+    # Failing-before: _GcsChannel.request defaulted to timeout=None, so
+    # a wedged GCS parked the calling control thread forever (the
+    # unbounded-wait finding over ~20 worker.py sites). Now the
+    # gcs_rpc_timeout_s knob bounds it by default.
+    import time
+
+    from ray_tpu._private import protocol
+    from ray_tpu._private.config import config
+    from ray_tpu._private.worker import _GcsChannel
+
+    black_hole = protocol.Server(lambda conn, mtype, payload, msg_id: None,
+                                 name="black-hole")
+    old = config.gcs_rpc_timeout_s
+    config.set("gcs_rpc_timeout_s", 0.3)
+    ch = None
+    try:
+        ch = _GcsChannel(black_hole.address, None, "t")
+        t0 = time.time()
+        with pytest.raises(TimeoutError):
+            ch.request("never_answered", {})
+        assert time.time() - t0 < 5.0
+    finally:
+        config.set("gcs_rpc_timeout_s", old)
+        if ch is not None:
+            ch.close()
+        black_hole.close()
+
+
+def test_gcs_channel_unbounded_sentinel_outlives_the_default_bound():
+    # The explicit opt-out for server-parked waits (wait_for_objects
+    # with no user deadline): a reply arriving AFTER the default bound
+    # must still fulfill an UNBOUNDED request.
+    import threading as _t
+    import time
+
+    from ray_tpu._private import protocol
+    from ray_tpu._private.config import config
+    from ray_tpu._private.worker import _GcsChannel
+
+    def slow_handler(conn, mtype, payload, msg_id):
+        _t.Timer(0.8, lambda: conn.reply(msg_id, "late")).start()
+
+    srv = protocol.Server(slow_handler, name="slow")
+    old = config.gcs_rpc_timeout_s
+    config.set("gcs_rpc_timeout_s", 0.2)
+    ch = None
+    try:
+        ch = _GcsChannel(srv.address, None, "t")
+        assert ch.request("parked", {}, timeout=ch.UNBOUNDED) == "late"
+    finally:
+        config.set("gcs_rpc_timeout_s", old)
+        if ch is not None:
+            ch.close()
+        srv.close()
+
+
+def test_request_timeout_abandons_pending_slot():
+    # With control RPCs bounded by default, a timed-out request must not
+    # leave its future registered on the conn (one leaked entry per
+    # timeout for the life of the connection, plus late replies
+    # resolving into futures nobody holds).
+    from ray_tpu._private import protocol
+
+    black_hole = protocol.Server(lambda conn, mtype, payload, msg_id: None,
+                                 name="black-hole-pending")
+    conn = None
+    try:
+        conn = protocol.connect(black_hole.address)
+        with pytest.raises(TimeoutError):
+            conn.request("never_answered", {}, timeout=0.2)
+        assert conn._pending == {}
+    finally:
+        if conn is not None:
+            conn.close()
+        black_hole.close()
+
+
+def test_empty_env_string_means_unset():
+    # `RAY_TPU_FOO= cmd` (set-but-empty) must resolve to the default,
+    # not coerce "" (which crashes numeric knobs and silently flips
+    # bool knobs to False — the old raw-read contract kept empty
+    # enabled).
+    import os as _os
+
+    from ray_tpu._private.config import Config
+
+    _os.environ["RAY_TPU_PROBE_EMPTY_BOOL"] = ""
+    try:
+        c = Config()
+        c.define("probe_empty_bool", True, "probe")
+        assert c.probe_empty_bool is True
+    finally:
+        del _os.environ["RAY_TPU_PROBE_EMPTY_BOOL"]
+
+
+def test_migrated_env_knobs_are_registered():
+    # Failing-before: these rode raw os.environ reads scattered over
+    # four modules (the config-knob-drift findings); now they are typed
+    # registry entries with docs and defaults.
+    from ray_tpu._private.config import config
+
+    # Defaults via the entry table, not live values — the suite itself
+    # may run with RAY_TPU_LOCKDEP_ENABLED=1 (tier-1 does).
+    e = config._entries
+    assert e["gcs_rpc_timeout_s"].default == 60.0
+    assert e["address"].default == ""
+    assert e["store_so"].default == ""
+    assert e["usage_stats_enabled"].default is True
+    assert e["lockdep_enabled"].default is False
+    for name in ("gcs_rpc_timeout_s", "address", "store_so",
+                 "usage_stats_enabled", "lockdep_enabled"):
+        assert e[name].doc, name
+
+
+def test_usage_stats_toggle_reads_the_registry():
+    from ray_tpu._private import usage
+    from ray_tpu._private.config import config
+
+    old = config.usage_stats_enabled
+    try:
+        config.set("usage_stats_enabled", False)
+        assert usage.usage_stats_enabled() is False
+        config.set("usage_stats_enabled", True)
+        assert usage.usage_stats_enabled() is True
+    finally:
+        config.set("usage_stats_enabled", old)
+
+
+# ------------------------------------------------------------- lockdep
+
+def test_lockdep_witnesses_ab_ba_cycle():
+    was_installed = lockdep.installed()
+    lockdep.install()
+    lockdep.reset()
+    try:
+        A = lockdep.tracked(key="fixture:A")
+        B = lockdep.tracked(key="fixture:B")
+
+        def order(first, second):
+            with first:
+                with second:
+                    pass
+
+        t1 = threading.Thread(target=order, args=(A, B))
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=order, args=(B, A))
+        t2.start()
+        t2.join()
+
+        found = lockdep.take_violations()
+        assert len(found) == 1, found
+        witness = found[0]
+        assert "fixture:A" in witness.cycle and "fixture:B" in witness.cycle
+        # The cycle closes back on itself and both edges carry sites.
+        assert witness.cycle[0] == witness.cycle[-1]
+        assert len(witness.edge_sites) == len(witness.cycle) - 1
+        assert all(s != "?" for s in witness.edge_sites)
+        assert "lock-order cycle" in str(witness)
+    finally:
+        lockdep.reset()
+        if not was_installed:
+            lockdep.uninstall()
+
+
+def test_lockdep_consistent_order_and_recursion_are_clean():
+    was_installed = lockdep.installed()
+    lockdep.install()
+    lockdep.reset()
+    try:
+        A = lockdep.tracked(key="fixture:A2")
+        B = lockdep.tracked(key="fixture:B2")
+        # Reentrant inner lock: the recursion case below re-acquires the
+        # SAME instance on one thread, which a plain Lock would turn
+        # into an immediate self-deadlock (the very bug class under
+        # test — rediscovered live by this fixture's first draft).
+        R = lockdep.tracked(threading.RLock(), key="fixture:R")
+        for _ in range(3):
+            with A:
+                with B:
+                    pass
+        with R:
+            with R:   # same instance: recursion, no self-edge
+                pass
+        assert lockdep.take_violations() == []
+        graph = lockdep.graph_snapshot()
+        assert "fixture:B2" in graph.get("fixture:A2", set())
+    finally:
+        lockdep.reset()
+        if not was_installed:
+            lockdep.uninstall()
+
+
+def test_lockdep_trylock_creates_no_blocking_edge():
+    # acquire(blocking=False) can never wait, so it can never close a
+    # deadlock cycle — the protocol layer's inline-send fast path
+    # (acquire(False) on _write_lock under NM handlers that hold the
+    # NM lock) vs the writer thread's close() path is the real-world
+    # benign inversion this encodes. A trylock-HELD lock is still a
+    # valid source of edges for later blocking acquires.
+    was_installed = lockdep.installed()
+    lockdep.install()
+    lockdep.reset()
+    try:
+        A = lockdep.tracked(key="fixture:TA")
+        B = lockdep.tracked(key="fixture:TB")
+        C = lockdep.tracked(key="fixture:TC")
+
+        def blocking_ab():
+            with A:
+                with B:
+                    pass
+
+        t = threading.Thread(target=blocking_ab)
+        t.start()
+        t.join()
+        # Reverse order, but via trylock: no B->A edge, no cycle.
+        with B:
+            assert A.acquire(blocking=False)
+            A.release()
+        assert lockdep.take_violations() == []
+        graph = lockdep.graph_snapshot()
+        assert "fixture:TA" not in graph.get("fixture:TB", set())
+        # Held-side still works: trylock-held A + blocking C = A->C.
+        assert A.acquire(blocking=False)
+        try:
+            with C:
+                pass
+        finally:
+            A.release()
+        assert "fixture:TC" in lockdep.graph_snapshot().get(
+            "fixture:TA", set())
+    finally:
+        lockdep.reset()
+        if not was_installed:
+            lockdep.uninstall()
+
+
+def test_lockdep_condition_over_tracked_lock():
+    was_installed = lockdep.installed()
+    lockdep.install()
+    lockdep.reset()
+    try:
+        cv = threading.Condition(lockdep.tracked(key="fixture:CVL"))
+        hits = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+                hits.append(1)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.2)
+        with cv:
+            cv.notify_all()
+        t.join(timeout=5)
+        assert hits == [1]
+        assert lockdep.take_violations() == []
+    finally:
+        lockdep.reset()
+        if not was_installed:
+            lockdep.uninstall()
+
+
+def test_lockdep_factory_wraps_only_ray_tpu_locks():
+    was_installed = lockdep.installed()
+    lockdep.install()
+    try:
+        from ray_tpu._private.config import Config
+
+        c = Config()   # Config.__init__ runs in a ray_tpu file
+        assert type(c._lock).__name__ == "_TrackedLock"
+        here = threading.Lock()   # this test file is outside ray_tpu/
+        assert type(here).__name__ != "_TrackedLock"
+    finally:
+        if not was_installed:
+            lockdep.uninstall()
+        lockdep.reset()
+        lockdep.take_violations()

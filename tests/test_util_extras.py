@@ -152,7 +152,21 @@ def test_usage_stats(ray_start_regular):
 
 
 def test_usage_stats_opt_out(ray_start_regular, monkeypatch):
+    # The opt-out lives on the typed registry (knob usage_stats_enabled)
+    # but the env contract survives: usage_stats_enabled() refreshes the
+    # knob from RAY_TPU_USAGE_STATS_ENABLED whenever it is set.
     from ray_tpu._private import usage
-    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
-    assert not usage.usage_stats_enabled()
-    assert usage.write_usage_report() is None
+    from ray_tpu._private.config import config
+    try:
+        monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+        assert not usage.usage_stats_enabled()
+        assert usage.write_usage_report() is None
+        monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+        assert usage.usage_stats_enabled()
+    finally:
+        # refresh_from_env persists the env value into the SHARED
+        # registry; monkeypatch restores only the env — put the knob
+        # back even when an assert above fails, or later tests inherit
+        # a disabled-stats registry with a misleading failure.
+        monkeypatch.delenv("RAY_TPU_USAGE_STATS_ENABLED", raising=False)
+        config.set("usage_stats_enabled", True)
