@@ -1,112 +1,553 @@
-"""BASELINE config 5: LLM inference deployment with autoscaled
-replicas — a llama-style decoder served through ray_tpu.serve, driven
-with concurrent requests until queue-depth autoscaling adds replicas.
+"""BASELINE config 5 / ROADMAP serving bench: closed-loop LLM load
+generator against the disaggregated serving tier.
 
-On TPU hosts each replica pins chips via ray_actor_options
-{"num_tpus": N}; this harness runs the "llama-tiny" preset so it also
-executes on the CPU test platform.
+Drives >= 1k concurrent closed-loop sessions (each session issues its
+next request the moment the previous one completes) against an
+autoscaled engine pool and reports:
 
-Prints JSON lines: per-phase tokens/s and the replica count trajectory.
+- aggregate tokens/s
+- p50/p95 TTFT (client-observed time to first streamed token)
+- p50/p95 per-token latency (inter-token gap over the stream)
+- the replica-count trajectory (scale-up under backlog AND scale-down
+  after drain)
+
+Sessions ride the engine's decoupled submit/collect API: one batched
+``collect`` RPC per replica per tick serves every session parked there,
+so client RPC rate scales with the poll rate, not the session count —
+the pattern that makes 1k+ concurrent sessions drivable from one
+process on the CPU test platform.
+
+A/B: ``--mode baseline`` runs the SAME harness against a
+one-request-per-call replica (the pre-engine serving shape: every
+request is its own ``generate()``); ``--mode engine`` is the
+continuous-batching pool. ``--mode all`` (default) runs both plus the
+same-process KV-handoff probe (device-object copy counters) and the
+handle-routing A/B microbench (pushed stats vs per-request stats RPCs).
+
+On TPU hosts pin replicas to chips via ``--num-tpus-per-replica``; the
+default preset is CPU-sized.
 """
 
 import argparse
 import json
 import os
+import random
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+ENGINE_POOL = "llm-engine"
+BASELINE_POOL = "llm-baseline"
+
+
+def _engine_config(args):
+    # CPU-preset model sized so DECODE IS WEIGHT-STREAMING BOUND (the
+    # production LLM regime): per batch-1 token the head alone streams
+    # vocab*d_model*4B = 65 MB, so one-request-per-call throughput caps
+    # at memory bandwidth / 65 MB while the slotted batch amortizes the
+    # stream across every occupied slot — the continuous-batching win
+    # the A/B measures.
+    return dict(
+        preset="llama-tiny",
+        model_overrides={"n_layers": args.model_layers,
+                         "d_model": args.model_dim,
+                         "n_heads": 8,
+                         "d_ff": args.model_dim * 3,
+                         "dtype": "float32"},
+        max_slots=args.max_slots,
+        max_len=64,
+        prompt_buckets=(16,),
+        max_new_tokens=32,
+        max_queue=8192,
+    )
+
+
+def _autoscaling(args):
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    return AutoscalingConfig(
+        min_replicas=1, max_replicas=args.max_replicas,
+        target_ongoing_requests=args.target_ongoing,
+        upscale_delay_s=0.3, downscale_delay_s=1.5,
+        look_back_period_s=1.5)
+
+
+class _Session:
+    __slots__ = ("sid", "rng", "req_id", "t_submit", "t_first", "t_prev",
+                 "gaps", "tokens", "replica")
+
+    def __init__(self, sid):
+        self.sid = sid
+        self.rng = random.Random(sid)
+        self.req_id = None
+        self.replica = None
+        self.t_submit = 0.0
+        self.t_first = None
+        self.t_prev = None
+        self.gaps = []
+        self.tokens = 0
+
+    def make_request(self, n_tokens):
+        plen = self.rng.randint(4, 12)
+        return {"prompt": [self.rng.randint(1, 30000) for _ in
+                           range(plen)],
+                "n": n_tokens, "seed": self.sid}
+
+
+def _percentiles(xs, ps=(50, 95)):
+    if not xs:
+        return {f"p{p}": None for p in ps}
+    xs = sorted(xs)
+    return {f"p{p}": round(xs[min(len(xs) - 1,
+                                  int(len(xs) * p / 100))], 4)
+            for p in ps}
+
+
+def _pool_replicas(pool):
+    import ray_tpu
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(ctrl.get_replicas.remote(pool), timeout=10)
+
+
+def _replica_count(pool):
+    from ray_tpu import serve
+
+    # serve.status() returns {} while the controller (re)starts — never
+    # assume the key exists (the old bench KeyError'd here).
+    return serve.status().get(pool, {}).get("num_replicas", 0)
+
+
+def run_engine_load(args):
+    """Closed-loop sessions against the continuous-batching pool via
+    submit + per-replica batched collect."""
+    import ray_tpu
+
+    sessions = [_Session(i) for i in range(args.sessions)]
+    ttfts, per_token, latencies = [], [], []
+    done_requests = 0
+    total_tokens = 0
+    trajectory = []
+
+    replicas = _pool_replicas(ENGINE_POOL)
+    if not replicas:
+        raise RuntimeError("engine pool has no replicas")
+    rr = 0
+
+    def start_session(s, now):
+        nonlocal rr
+        s.replica = replicas[rr % len(replicas)]
+        rr += 1
+        s.t_submit = now
+        s.t_first = None
+        s.t_prev = None
+        s.gaps = []
+        s.tokens = 0
+        s.req_id = None
+        # Replicas are generic serve wrappers: engine methods dispatch
+        # through handle_request(method, args, kwargs).
+        return s.replica.handle_request.remote(
+            "submit", (s.make_request(args.new_tokens),), {})
+
+    trajectory.append(_replica_count(ENGINE_POOL))  # pre-flood floor
+    now = time.perf_counter()
+    pending_submit = {start_session(s, now): s for s in sessions}
+    t_end = time.perf_counter() + args.duration
+    t_sample = 0.0
+    issuing = True
+
+    while True:
+        now = time.perf_counter()
+        if now >= t_sample:
+            trajectory.append(_replica_count(ENGINE_POOL))
+            replicas = _pool_replicas(ENGINE_POOL) or replicas
+            t_sample = now + 0.5
+        if issuing and now >= t_end:
+            issuing = False
+
+        # Resolve submit acks -> request ids.
+        if pending_submit:
+            refs = list(pending_submit)
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=0.02)
+            for ref in ready:
+                s = pending_submit.pop(ref)
+                try:
+                    s.req_id = ray_tpu.get(ref, timeout=5)
+                except Exception:
+                    if issuing:   # replica died (downscale): resubmit
+                        pending_submit[start_session(s, now)] = s
+
+        # One batched collect per replica serves all its sessions.
+        by_replica = {}
+        for s in sessions:
+            if s.req_id is not None:
+                by_replica.setdefault(id(s.replica), []).append(s)
+        for group in by_replica.values():
+            rep = group[0].replica
+            ids = [s.req_id for s in group]
+            try:
+                res = ray_tpu.get(
+                    rep.handle_request.remote("collect", (ids,), {}),
+                    timeout=10)
+            except Exception:
+                for s in group:   # replica died: restart the session
+                    s.req_id = None
+                    if issuing:
+                        pending_submit[start_session(s, now)] = s
+                continue
+            now = time.perf_counter()
+            for s in group:
+                out = res.get(s.req_id) or {}
+                got = out.get("tokens") or []
+                if got:
+                    if s.t_first is None:
+                        s.t_first = now
+                        ttfts.append(now - s.t_submit)
+                    else:
+                        gap = (now - s.t_prev) / len(got)
+                        s.gaps.extend([gap] * len(got))
+                    s.t_prev = now
+                    s.tokens += len(got)
+                if out.get("done"):
+                    done_requests += 1
+                    total_tokens += s.tokens
+                    latencies.append(now - s.t_submit)
+                    per_token.extend(s.gaps)
+                    s.req_id = None
+                    if issuing:
+                        pending_submit[start_session(s, now)] = s
+
+        outstanding = pending_submit or any(
+            s.req_id is not None for s in sessions)
+        if not issuing and not outstanding:
+            break
+        time.sleep(args.tick)
+
+    wall = time.perf_counter() - (t_end - args.duration)
+    # Post-drain: watch the pool scale back down.
+    floor_deadline = time.time() + args.downscale_wait
+    while time.time() < floor_deadline:
+        n = _replica_count(ENGINE_POOL)
+        trajectory.append(n)
+        if n <= 1:
+            break
+        time.sleep(0.5)
+
+    return {
+        "metric": "llm_serve_engine",
+        "mode": "continuous_batching",
+        "sessions": args.sessions,
+        "requests": done_requests,
+        "tokens_per_sec": round(total_tokens / wall, 1),
+        "ttft_s": _percentiles(ttfts),
+        "per_token_s": _percentiles(per_token),
+        "request_latency_s": _percentiles(latencies),
+        "replica_trajectory": trajectory,
+        "max_replicas_seen": max(trajectory or [0]),
+        "scaled_up": max(trajectory or [0]) > 1,
+        "scaled_down": bool(trajectory) and trajectory[-1] <= 1,
+    }
+
+
+def run_baseline_load(args):
+    """The same closed-loop session harness against one-request-per-call
+    replicas (each request is a full blocking ``generate()``)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    handle = serve.get_deployment_handle(BASELINE_POOL)
+    sessions = [_Session(i) for i in range(args.sessions)]
+    latencies = []
+    done_requests = 0
+    total_tokens = 0
+    trajectory = []
+
+    def start(s, now):
+        s.t_submit = now
+        req = s.make_request(args.new_tokens)
+        req["prompt"] += [0] * (16 - len(req["prompt"]))  # one jit shape
+        return handle.remote(req).ref
+
+    now = time.perf_counter()
+    outstanding = {start(s, now): s for s in sessions}
+    t_end = time.perf_counter() + args.duration
+    t_sample = 0.0
+    issuing = True
+
+    while outstanding:
+        now = time.perf_counter()
+        if now >= t_sample:
+            trajectory.append(_replica_count(BASELINE_POOL))
+            t_sample = now + 0.5
+        if issuing and now >= t_end:
+            issuing = False
+        refs = list(outstanding)
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                timeout=0.2)
+        now = time.perf_counter()
+        for ref in ready:
+            s = outstanding.pop(ref)
+            try:
+                out = ray_tpu.get(ref, timeout=5)
+                n_toks = len(out["tokens"])
+            except Exception:
+                n_toks = 0   # replica died; count nothing
+            if n_toks:
+                done_requests += 1
+                total_tokens += n_toks
+                latencies.append(now - s.t_submit)
+            if issuing:
+                outstanding[start(s, now)] = s
+
+    wall = time.perf_counter() - (t_end - args.duration)
+    floor_deadline = time.time() + args.downscale_wait
+    while time.time() < floor_deadline:
+        n = _replica_count(BASELINE_POOL)
+        trajectory.append(n)
+        if n <= 1:
+            break
+        time.sleep(0.5)
+
+    return {
+        "metric": "llm_serve_baseline",
+        "mode": "one_request_per_call",
+        "sessions": args.sessions,
+        "requests": done_requests,
+        "tokens_per_sec": round(total_tokens / wall, 1),
+        # No streaming in the baseline: the first token arrives with the
+        # whole response, so TTFT == request latency.
+        "ttft_s": _percentiles(latencies),
+        "per_token_s": _percentiles(
+            [latency / args.new_tokens for latency in latencies]),
+        "request_latency_s": _percentiles(latencies),
+        "replica_trajectory": trajectory,
+        "max_replicas_seen": max(trajectory or [0]),
+    }
+
+
+def run_handoff_probe(args):
+    """Same-process prefill -> publish -> adopt -> decode with the
+    device-object copy counters: the KV handoff must show ZERO host
+    materializations (and by-reference local hits) on this platform."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu._private import device_objects
+    from ray_tpu.models.generate import (
+        adopt_slot, decode_step, init_slotted_cache, prefill_slot,
+    )
+    from ray_tpu.serve.llm import EngineConfig, adopt_kv, publish_kv
+    from ray_tpu.serve.llm.replicas import _build_model
+
+    ec = EngineConfig.from_dict(_engine_config(args))
+    cfg, params = _build_model(ec)
+    prompt = [5, 9, 2, 11, 3]
+    padded = jnp.zeros((1, 16), jnp.int32).at[:, :len(prompt)].set(
+        jnp.asarray(prompt, jnp.int32))
+    first, kv = prefill_slot(params, padded, jnp.int32(len(prompt)),
+                             jnp.int32(0), cfg=cfg)
+    jax.block_until_ready(kv)
+    device_objects.reset_stats()
+    t0 = time.perf_counter()
+    handoff = publish_kv(kv, len(prompt), int(first[0]), n=8, seed=0)
+    adopted = adopt_kv(handoff)
+    handoff_ms = (time.perf_counter() - t0) * 1e3
+    stats = device_objects.stats()
+
+    # And prove the adopted cache decodes: 8 greedy tokens.
+    cache = adopt_slot(init_slotted_cache(cfg, 2, ec.max_len),
+                       jnp.int32(0), adopted, jnp.int32(len(prompt)))
+    last = jnp.zeros((2,), jnp.int32).at[0].set(handoff["first_token"])
+    active = jnp.zeros((2,), bool).at[0].set(True)
+    toks = [handoff["first_token"]]
+    for _ in range(7):
+        nxt, cache = decode_step(params, cache, last, active,
+                                 jnp.zeros((2,), jnp.int32), cfg=cfg)
+        toks.append(int(nxt[0]))
+        last = last.at[0].set(nxt[0])
+    return {
+        "metric": "llm_kv_handoff_probe",
+        "host_materializations": stats["host_materializations"],
+        "local_hits": stats["local_hits"],
+        "rebuilds": stats["rebuilds"],
+        "staged_bytes": stats["staged_bytes"],
+        "handoff_ms": round(handoff_ms, 3),
+        "decoded_tokens": len(toks),
+        "zero_copy": stats["host_materializations"] == 0,
+    }
+
+
+def run_handle_ab(args):
+    """Handle routing A/B: pushed per-replica loads (zero hot-path RPCs)
+    vs the legacy two-stats-RPCs-per-request probe."""
+    import threading
+
+    from ray_tpu import serve
+    from ray_tpu._private.config import config
+
+    @serve.deployment(num_replicas=2, name="route-ab")
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind(), http_port=None)
+    handle.remote(0).result(timeout=30)
+
+    def rps(duration=3.0, threads=4):
+        stop = time.perf_counter() + duration
+        counts = [0] * threads
+
+        def worker(i):
+            while time.perf_counter() < stop:
+                handle.remote(i).result(timeout=30)
+                counts[i] += 1
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return sum(counts) / duration
+
+    config.set("serve_handle_stats_rpc", True)
+    rps_rpc = rps()
+    config.set("serve_handle_stats_rpc", False)
+    rps_pushed = rps()
+    serve.delete("route-ab")
+    return {
+        "metric": "serve_handle_routing_ab",
+        "rps_stats_rpc": round(rps_rpc, 1),
+        "rps_pushed_stats": round(rps_pushed, 1),
+        "speedup": round(rps_pushed / max(rps_rpc, 1e-9), 2),
+    }
+
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="all",
+                    choices=["all", "engine", "baseline", "probe",
+                             "handle-ab"])
+    ap.add_argument("--sessions", type=int, default=1000)
+    ap.add_argument("--duration", type=float, default=15.0,
+                    help="load-phase seconds per mode")
     ap.add_argument("--new-tokens", type=int, default=16)
-    ap.add_argument("--clients", type=int, default=6)
-    ap.add_argument("--requests-per-client", type=int, default=4)
+    ap.add_argument("--max-slots", type=int, default=32)
+    ap.add_argument("--model-dim", type=int, default=512)
+    ap.add_argument("--model-layers", type=int, default=4)
+    ap.add_argument("--max-replicas", type=int, default=3)
+    ap.add_argument("--target-ongoing", type=float, default=32.0,
+                    help="autoscaler target load per engine replica")
+    ap.add_argument("--tick", type=float, default=0.025,
+                    help="collect poll period (s)")
+    ap.add_argument("--downscale-wait", type=float, default=45.0)
+    ap.add_argument("--baseline-static-replicas", type=int, default=3,
+                    help="pre-grant the one-call baseline this many "
+                         "static replicas (0 = autoscaled like the "
+                         "engine pool)")
+    ap.add_argument("--num-tpus-per-replica", type=int, default=0)
     args = ap.parse_args()
 
     import ray_tpu
     from ray_tpu import serve
-    from ray_tpu.serve.config import AutoscalingConfig
+    from ray_tpu.serve.llm import build_llm_app
 
-    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
-    serve.start()
+    ray_tpu.init(num_cpus=8, object_store_memory=512 * 1024 * 1024)
+    serve.start(http_port=None)
+    results = []
+    opts = {"num_tpus": args.num_tpus_per_replica} \
+        if args.num_tpus_per_replica else None
     try:
-        new_tokens = args.new_tokens
+        if args.mode in ("all", "probe"):
+            results.append(run_handoff_probe(args))
+            print(json.dumps(results[-1]), flush=True)
 
-        @serve.deployment(
-            name="llm",
-            autoscaling_config=AutoscalingConfig(
-                min_replicas=1, max_replicas=3,
-                target_ongoing_requests=1.0,
-                upscale_delay_s=0.2, look_back_period_s=1.0),
-        )
-        class LLM:
-            def __init__(self):
-                import jax
-                import numpy as np
+        if args.mode in ("all", "engine"):
+            handle = serve.run(
+                build_llm_app(_engine_config(args), mode="combined",
+                              name="llm",
+                              autoscaling_config=_autoscaling(args),
+                              ray_actor_options=opts),
+                route_prefix="/llm")
+            handle.remote({"prompt": [1, 2, 3],
+                           "n": args.new_tokens}).result(timeout=600)
+            results.append(run_engine_load(args))
+            print(json.dumps(results[-1]), flush=True)
+            serve.delete("llm")
+            serve.delete(ENGINE_POOL)
 
-                from ray_tpu.models import GPTConfig, init_params
-                from ray_tpu.models.generate import generate
+        if args.mode in ("all", "baseline"):
+            from ray_tpu.serve.llm.replicas import normalize_request
 
-                self.cfg = GPTConfig.preset("llama-tiny", n_layers=2,
-                                            max_seq=128)
-                self.params = init_params(jax.random.key(0), self.cfg)
-                self._generate = generate
-                self._jax = jax
-                self._np = np
+            ecfg = _engine_config(args)
 
-            def __call__(self, req):
-                import jax.numpy as jnp
+            # The blocking one-call-per-request shape starves the
+            # controller's stats probes under load (every actor thread
+            # is parked in generate()), so its autoscaler rarely fires —
+            # itself a finding. --baseline-static-replicas N grants the
+            # baseline the engine pool's PEAK capacity up front instead,
+            # the strongest version of the comparison.
+            static_n = args.baseline_static_replicas
+            @serve.deployment(
+                name=BASELINE_POOL, max_ongoing_requests=64,
+                num_replicas=static_n or 1,
+                autoscaling_config=None if static_n
+                else _autoscaling(args),
+                ray_actor_options=opts or {})
+            class OneCallLLM:
+                """Pre-engine shape: every request runs its own
+                ``generate()`` — no batching across requests."""
 
-                prompt = jnp.asarray(
-                    self._np.asarray(req["prompt"], self._np.int32))[None]
-                out = self._generate(
-                    self.params, prompt, self._jax.random.key(0),
-                    cfg=self.cfg, max_new_tokens=req["n"])
-                return {"tokens": self._np.asarray(out)[0].tolist()}
+                def __init__(self):
+                    import jax as _jax
 
-        handle = serve.run(LLM.bind(), route_prefix="/llm")
-        # Warm one request (compiles the decode loop).
-        out = handle.remote({"prompt": [1, 2, 3], "n": new_tokens}).result(
-            timeout=600)
-        assert len(out["tokens"]) >= new_tokens
+                    from ray_tpu.serve.llm import EngineConfig
+                    from ray_tpu.serve.llm.replicas import _build_model
 
-        results = []
-        lock = threading.Lock()
+                    self._jax = _jax
+                    ec = EngineConfig.from_dict(ecfg)
+                    self.cfg, self.params = _build_model(ec)
 
-        def client(cid):
-            for i in range(args.requests_per_client):
-                t0 = time.perf_counter()
-                handle.remote({"prompt": [1 + cid, 2, 3],
-                               "n": new_tokens}).result(timeout=600)
-                with lock:
-                    results.append(time.perf_counter() - t0)
+                def __call__(self, request):
+                    import jax.numpy as _jnp
 
-        t0 = time.perf_counter()
-        threads = [threading.Thread(target=client, args=(c,))
-                   for c in range(args.clients)]
-        for t in threads:
-            t.start()
-        replica_trajectory = []
-        while any(t.is_alive() for t in threads):
-            replica_trajectory.append(
-                serve.status()["llm"]["num_replicas"])
-            time.sleep(0.5)
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        n_req = args.clients * args.requests_per_client
-        print(json.dumps({
-            "metric": "llm_serve_tokens_per_sec",
-            "value": round(n_req * new_tokens / wall, 1),
-            "unit": "tokens/s",
-            "requests": n_req,
-            "p50_latency_s": round(sorted(results)[len(results) // 2], 3),
-            "max_replicas_seen": max(replica_trajectory or [1]),
-            "replica_trajectory": replica_trajectory,
-        }), flush=True)
+                    from ray_tpu.models.generate import generate
+
+                    req = normalize_request(request)
+                    out = generate(
+                        self.params,
+                        _jnp.asarray([req["prompt"]], _jnp.int32),
+                        self._jax.random.key(req["seed"]),
+                        cfg=self.cfg, max_new_tokens=req["n"] or 16,
+                        temperature=0.0)
+                    return {"tokens": [int(t) for t in out[0]]}
+
+            handle = serve.run(OneCallLLM.bind(), http_port=None)
+            handle.remote({"prompt": [1, 2, 3] + [0] * 13,
+                           "n": args.new_tokens}).result(timeout=600)
+            results.append(run_baseline_load(args))
+            print(json.dumps(results[-1]), flush=True)
+            serve.delete(BASELINE_POOL)
+
+        if args.mode in ("all", "handle-ab"):
+            results.append(run_handle_ab(args))
+            print(json.dumps(results[-1]), flush=True)
+
+        eng = next((r for r in results
+                    if r["metric"] == "llm_serve_engine"), None)
+        base = next((r for r in results
+                     if r["metric"] == "llm_serve_baseline"), None)
+        if eng and base:
+            print(json.dumps({
+                "metric": "llm_serve_ab_summary",
+                "engine_tokens_per_sec": eng["tokens_per_sec"],
+                "baseline_tokens_per_sec": base["tokens_per_sec"],
+                "speedup": round(eng["tokens_per_sec"] /
+                                 max(base["tokens_per_sec"], 1e-9), 2),
+            }), flush=True)
     finally:
         serve.shutdown()
         ray_tpu.shutdown()

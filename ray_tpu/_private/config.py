@@ -313,6 +313,15 @@ _d("tpu_chips_per_host", 4,
    "Chips driven by one host on the modeled pod (v4/v5p default).")
 _d("tpu_topology", "", "Override slice topology string, e.g. '2x2x1'.")
 
+# --- serve ------------------------------------------------------------------
+_d("serve_handle_stats_rpc", False,
+   "Legacy handle routing: issue two blocking stats.remote() probes per "
+   "request for power-of-two choices. Default off — handles route on "
+   "per-replica loads PUSHED over the controller's replicas long-poll "
+   "channel (plus local optimistic in-flight deltas), zero hot-path "
+   "RPCs. Kept as the A/B baseline for the routing microbench. "
+   "Env: RAY_TPU_SERVE_HANDLE_STATS_RPC.")
+
 # --- correctness tooling ----------------------------------------------------
 _d("lockdep_enabled", False,
    "Runtime lock-order witness (ray_tpu._private.lockdep): wrap every "

@@ -45,6 +45,13 @@ CONTROL_PLANE = (
     "ray_tpu/_private/device_objects.py",
     "ray_tpu/parallel/collective.py",
     "ray_tpu/train/worker_group.py",
+    # The LLM serving tier: the engine's scheduler thread and the
+    # router's pool fan-out are daemon paths — an unbounded wait there
+    # wedges every request parked on the replica.
+    "ray_tpu/serve/llm/engine.py",
+    "ray_tpu/serve/llm/replicas.py",
+    "ray_tpu/serve/llm/router.py",
+    "ray_tpu/serve/llm/kv_transfer.py",
 )
 
 # The subset where a swallowed GangMemberDiedError / RayActorError turns

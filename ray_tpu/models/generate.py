@@ -141,6 +141,222 @@ def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Slotted batch (continuous / in-flight batching substrate)
+#
+# ``generate`` above is one compiled program per request shape — fine for
+# offline sampling, wrong for serving: a new request must wait for the
+# whole scan to finish. The serving engine (ray_tpu.serve.llm) instead
+# keeps a FIXED-SHAPE batch of ``slots``, each slot an independent
+# sequence with its own cache length, and runs three separately-jitted
+# programs:
+#
+# - ``prefill_slot``   — one prompt (padded to a static bucket length)
+#                        through the network; returns the sampled first
+#                        token and a bucket-sized KV block.
+# - ``adopt_slot``     — splice a prefill KV block into one slot of the
+#                        batch cache (donated, so it's an in-place write
+#                        where XLA supports aliasing).
+# - ``decode_step``    — one token for every slot at once; per-slot
+#                        lengths/masks so slots at different positions
+#                        coexist; inactive slots are computed but masked.
+#
+# Static shapes throughout: XLA compiles once per (bucket, slot-count)
+# and requests join/leave between steps without retracing. Pad garbage
+# beyond a slot's true length is never visible (attention masks keys
+# ``> length``) and is overwritten as the sequence advances.
+
+
+def init_slotted_cache(cfg: GPTConfig, slots: int,
+                       max_len: int) -> Dict[str, Any]:
+    """KV cache for ``slots`` independent sequences + per-slot lengths."""
+    L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
+    return {
+        "k": jnp.zeros((L, slots, max_len, H, Dh), cfg.dtype),
+        "v": jnp.zeros((L, slots, max_len, H, Dh), cfg.dtype),
+        "lengths": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def _rope_batched(x: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotary embeddings with PER-SLOT positions: x [B, S, H, Dh],
+    positions [B, S] (each slot sits at its own sequence offset)."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _write_slot_kv(cache_layer: jax.Array, new: jax.Array,
+                   lengths: jax.Array) -> jax.Array:
+    """Write one new K or V row per slot at that slot's own position:
+    cache_layer [B, T, H, Dh], new [B, 1, H, Dh], lengths [B]."""
+
+    def one(c, n, pos):
+        return lax.dynamic_update_slice(c, n, (pos, 0, 0))
+
+    return jax.vmap(one)(cache_layer, new, lengths)
+
+
+def _attn_slotted(q, k_cache, v_cache, lengths, scale):
+    """Single-token attention with per-slot visibility: q [B, 1, H, Dh];
+    slot b sees cache positions ``<= lengths[b]`` (its own new token
+    included — it was just written at ``lengths[b]``)."""
+    t = k_cache.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale
+    visible = jnp.arange(t)[None, :] <= lengths[:, None]      # [B, T]
+    logits = jnp.where(visible[:, None, None, :], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
+                      v_cache)
+
+
+def _block_decode(x, bp, layer_cache, lengths, cfg: GPTConfig):
+    """One block over one new token per slot. Returns (out, new_k, new_v)
+    with the full cache rows rebound (donation makes this in-place)."""
+    cd = cfg.dtype
+    scale = cfg.head_dim ** -0.5
+
+    h = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], cfg.eps)
+    qkv = jnp.einsum("bld,dshk->blshk", h, bp["wqkv"].astype(cd)) + \
+        bp["bqkv"].astype(cd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if cfg.rotary:
+        positions = lengths[:, None]                          # [B, 1]
+        q = _rope_batched(q, positions)
+        k = _rope_batched(k, positions)
+    k_cache, v_cache = layer_cache
+    k_cache = _write_slot_kv(k_cache, k.astype(k_cache.dtype), lengths)
+    v_cache = _write_slot_kv(v_cache, v.astype(v_cache.dtype), lengths)
+    attn = _attn_slotted(q, k_cache, v_cache, lengths, scale)
+    proj = jnp.einsum("blhk,hkd->bld", attn, bp["wo"].astype(cd)) + \
+        bp["bo"].astype(cd)
+    x = x + proj
+
+    from ray_tpu.models.transformer import _ffn
+
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"], cfg.eps)
+    down = _ffn(h, bp, cfg, lambda y, *a: y)
+    return x + down, k_cache, v_cache
+
+
+def _forward_decode(params: Params, tokens: jax.Array, cache,
+                    cfg: GPTConfig) -> Tuple[jax.Array, Dict[str, Any]]:
+    """One decode token per slot. tokens [B] int32; returns (last-token
+    logits [B, V], cache with the new K/V written — lengths NOT yet
+    advanced; the caller advances only the active slots)."""
+    cd = cfg.dtype
+    lengths = cache["lengths"]
+
+    x = jnp.take(params["tok_embed"], tokens[:, None], axis=0).astype(cd)
+    if not cfg.rotary:
+        x = x + jnp.take(params["pos_embed"], lengths,
+                         axis=0)[:, None].astype(cd)
+
+    def scan_body(carry, inputs):
+        bp, (kc, vc) = inputs
+        out, nk, nv = _block_decode(carry, bp, (kc, vc), lengths, cfg)
+        return out, (nk, nv)
+
+    x, (new_k, new_v) = lax.scan(
+        scan_body, x, (params["blocks"], (cache["k"], cache["v"])))
+
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.eps)
+    logits = jnp.einsum("bd,vd->bv", x[:, 0].astype(jnp.float32),
+                        params["tok_embed"].astype(jnp.float32))
+    return logits, {"k": new_k, "v": new_v, "lengths": lengths}
+
+
+def _request_key(seed: jax.Array, counter: jax.Array) -> jax.Array:
+    """Per-request, per-position sampling key: deterministic in (seed,
+    position) so a request's tokens do not depend on which other
+    requests share the batch (the isolation contract of in-flight
+    batching)."""
+    return jax.random.fold_in(jax.random.fold_in(
+        jax.random.key(0), seed), counter)
+
+
+def _sample_one(logits: jax.Array, seed: jax.Array, counter: jax.Array,
+                temperature: float, top_k: int) -> jax.Array:
+    """Sample one token from one slot's logits [V]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits)[-top_k]
+        logits = jnp.where(logits >= kth, logits, _NEG_INF)
+    return jax.random.categorical(
+        _request_key(seed, counter), logits).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "temperature", "top_k"))
+def prefill_slot(params: Params, prompt: jax.Array, true_len: jax.Array,
+                 seed: jax.Array, *, cfg: GPTConfig,
+                 temperature: float = 0.0,
+                 top_k: int = 0) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Prefill ONE request padded to a static bucket: prompt [1, bucket]
+    (positions ``>= true_len`` are pad). Returns (first sampled token
+    [1], bucket-sized KV block {"k","v": [L, 1, bucket, H, Dh]}).
+
+    Compiles once per bucket length. Pad garbage in the KV block beyond
+    ``true_len`` is masked by the per-slot length after adoption and
+    overwritten as decoding advances through those positions.
+    """
+    b, s = prompt.shape
+    cache = init_cache(cfg, b, s)
+    logits, cache = _forward_cached(params, prompt, cache, cfg)
+    last = jnp.take(logits[0], true_len - 1, axis=0)          # [V]
+    first = _sample_one(last, seed, true_len, temperature, top_k)
+    return first[None], {"k": cache["k"], "v": cache["v"]}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def adopt_slot(cache: Dict[str, Any], slot: jax.Array,
+               kv: Dict[str, Any], true_len: jax.Array) -> Dict[str, Any]:
+    """Splice a prefill KV block into slot ``slot`` of the batch cache
+    and set that slot's length. The batch cache is donated: with XLA
+    aliasing this is an in-place write, not a cache-sized copy."""
+    k = lax.dynamic_update_slice(
+        cache["k"], kv["k"].astype(cache["k"].dtype), (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(
+        cache["v"], kv["v"].astype(cache["v"].dtype), (0, slot, 0, 0, 0))
+    lengths = cache["lengths"].at[slot].set(true_len)
+    return {"k": k, "v": v, "lengths": lengths}
+
+
+@functools.partial(jax.jit, donate_argnums=(1,), static_argnames=(
+    "cfg", "temperature", "top_k"))
+def decode_step(params: Params, cache: Dict[str, Any], tokens: jax.Array,
+                active: jax.Array, seeds: jax.Array, *, cfg: GPTConfig,
+                temperature: float = 0.0,
+                top_k: int = 0) -> Tuple[jax.Array, Dict[str, Any]]:
+    """One decode step for the whole slotted batch.
+
+    tokens [B] — each slot's last sampled token; active [B] bool — slots
+    holding a live request (inactive slots are computed and discarded;
+    their lengths do not advance, so their writes land harmlessly on the
+    same masked position every step); seeds [B] — per-request sampling
+    seeds. Returns (next tokens [B], cache with active lengths +1).
+
+    The cache is donated: the engine rebinds it every step, and where
+    XLA supports input-output aliasing (TPU, and CPU on this jax) the
+    step updates the KV pages in place instead of copying the cache.
+    """
+    logits, cache = _forward_decode(params, tokens, cache, cfg)
+    new_lengths = cache["lengths"] + active.astype(jnp.int32)
+    nxt = jax.vmap(
+        lambda lg, sd, ctr: _sample_one(lg, sd, ctr, temperature, top_k)
+    )(logits, seeds, new_lengths)
+    return nxt, {"k": cache["k"], "v": cache["v"], "lengths": new_lengths}
+
+
 @functools.partial(jax.jit, static_argnames=(
     "cfg", "max_new_tokens", "max_len", "temperature", "top_k"))
 def generate(params: Params, prompt: jax.Array, rng: jax.Array, *,

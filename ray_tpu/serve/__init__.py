@@ -23,13 +23,22 @@ from ray_tpu.serve.api import (  # noqa: F401
     status,
 )
 from ray_tpu.serve.config import AutoscalingConfig  # noqa: F401
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.handle import (  # noqa: F401
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 
 __all__ = [
     "Application", "Deployment", "deployment", "delete", "get_app_handle",
     "get_deployment_handle", "run", "shutdown", "start", "status",
     "AutoscalingConfig", "DeploymentHandle", "DeploymentResponse",
+    "DeploymentResponseGenerator",
 ]
+
+# ``ray_tpu.serve.llm`` (the disaggregated LLM serving subsystem) is a
+# plain submodule — import it explicitly; it pulls in jax + the model
+# stack, which plain serve users shouldn't pay for.
 
 from ray_tpu._private import usage as _usage  # noqa: E402
 _usage.record_library_usage("serve")

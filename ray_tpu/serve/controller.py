@@ -29,6 +29,13 @@ _STATS_TIMEOUT_S = 2.0
 _MAX_PROBE_MISSES = 30
 
 
+def _load_from_stats(s: dict) -> float:
+    """A replica's routing/autoscaling load: plain deployments report
+    in-flight requests; engine deployments (serve.llm) override with
+    ``autoscale_load`` = queue depth + busy slots."""
+    return float(s.get("autoscale_load", s.get("ongoing", 0)))
+
+
 class _DeploymentState:
     def __init__(self, config: dict, callable_blob: bytes,
                  init_args, init_kwargs):
@@ -40,8 +47,12 @@ class _DeploymentState:
         self.target = config["num_replicas"]
         self.last_scale_ts = 0.0
         self.deleting = False
-        # (ts, total_ongoing) samples for the autoscaler's look-back window.
+        # (ts, total_load) samples for the autoscaler's look-back window.
         self.ongoing_history: List[tuple] = []
+        # Last per-replica load observed by the probe sweep, keyed by
+        # actor id hex — piggybacked on the replicas long-poll channel
+        # so handles route with ZERO hot-path stats RPCs.
+        self.pushed_stats: Dict[str, float] = {}
 
 
 class ServeController:
@@ -78,7 +89,10 @@ class ServeController:
             name = key.split(":", 1)[1]
             with self._lock:
                 st = self._deployments.get(name)
-                return list(st.replicas) if st else []
+                if st is None:
+                    return {"replicas": [], "ongoing": {}}
+                return {"replicas": list(st.replicas),
+                        "ongoing": dict(st.pushed_stats)}
         if key == "routes":
             with self._lock:
                 return {n: st.config.get("route_prefix")
@@ -294,8 +308,32 @@ class ServeController:
 
         now = time.time()
         for name, st in items:
+            self._push_replica_stats(name, st, stats_by_replica)
             self._autoscale_one(st, stats_by_replica, now)
             self._scale_to_target(name, st)
+
+    def _push_replica_stats(self, name: str, st: _DeploymentState,
+                            stats_by_replica: Dict[int, dict]):
+        """Piggyback observed per-replica load on the replicas long-poll
+        channel (bumped only on change, so an idle cluster stays quiet) —
+        handles route on these pushes instead of issuing two stats RPCs
+        per request."""
+        with self._lock:
+            replicas = list(st.replicas)
+        loads = {}
+        for r in replicas:
+            s = stats_by_replica.get(id(r))
+            if s is None:
+                continue
+            aid = getattr(r, "_actor_id", None)
+            key = aid.hex() if aid is not None else str(id(r))
+            loads[key] = _load_from_stats(s)
+        with self._lock:
+            changed = loads != st.pushed_stats
+            if changed:
+                st.pushed_stats = loads
+        if changed:
+            self._bump(f"replicas:{name}")
 
     def _autoscale_one(self, st: _DeploymentState,
                        stats_by_replica: Dict[int, dict], now: float):
@@ -315,7 +353,7 @@ class ServeController:
                  if id(r) in stats_by_replica]
         if not stats:
             return
-        sample = sum(s["ongoing"] for s in stats)
+        sample = sum(_load_from_stats(s) for s in stats)
         window = float(ac.get("look_back_period_s") or 0.0)
         with self._lock:
             st.ongoing_history.append((now, sample))

@@ -2,14 +2,23 @@
 ``_private/router.py:261`` Router).
 
 ``handle.remote(...)`` picks the least-loaded replica (power of two
-choices over cached stats, reference: router's replica set scheduling)
-and returns a ``DeploymentResponse`` whose ``.result()`` blocks.
+choices) and returns a ``DeploymentResponse`` whose ``.result()``
+blocks; ``handle.remote_gen(...)`` / ``method.remote_gen(...)`` opens a
+streaming response (an iterator over the replica generator's items).
 
 Replica-set updates are PUSHED: a background listener long-polls the
 controller's versioned channel (reference: LongPollClient,
 _private/long_poll.py:68) so membership changes land within one notify;
 the TTL refresh remains only as bootstrap + fallback while the listener
 is (re)connecting.
+
+Routing load is pushed too: the controller piggybacks each replica's
+observed load (``autoscale_load`` — in-flight requests, plus engine
+queue depth for deployments that expose it) on the same channel, and
+the handle layers its own optimistic in-flight deltas on top. The
+request hot path therefore makes ZERO stats RPCs (the legacy
+two-``stats.remote()``-per-request probe survives behind the
+``serve_handle_stats_rpc`` config knob as the A/B baseline).
 """
 
 from __future__ import annotations
@@ -17,15 +26,25 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 _REPLICA_CACHE_TTL_S = 1.0
+_STREAM_START_TIMEOUT_S = 120.0
+
+
+def _aid(replica) -> str:
+    """Stable routing key for a replica actor handle."""
+    try:
+        return replica._actor_id.hex()
+    except Exception:
+        return str(id(replica))
 
 
 class DeploymentResponse:
-    def __init__(self, ref, resubmit=None):
+    def __init__(self, ref, resubmit=None, on_done=None):
         self._ref = ref
         self._resubmit = resubmit
+        self._on_done = on_done
 
     def result(self, timeout: Optional[float] = None):
         """Block for the response. If the serving replica died
@@ -37,20 +56,84 @@ class DeploymentResponse:
         from ray_tpu import exceptions
 
         attempts = 3
-        while True:
+        try:
+            while True:
+                try:
+                    return ray_tpu.get(self._ref, timeout=timeout)
+                except (exceptions.RayActorError,
+                        exceptions.WorkerCrashedError):
+                    if self._resubmit is None or attempts <= 0:
+                        raise
+                    attempts -= 1
+                    time.sleep(0.2)
+                    self._ref = self._resubmit()
+        finally:
+            self._done()
+
+    def _done(self):
+        cb, self._on_done = self._on_done, None
+        if cb is not None:
             try:
-                return ray_tpu.get(self._ref, timeout=timeout)
-            except (exceptions.RayActorError,
-                    exceptions.WorkerCrashedError):
-                if self._resubmit is None or attempts <= 0:
-                    raise
-                attempts -= 1
-                time.sleep(0.2)
-                self._ref = self._resubmit()
+                cb()
+            except Exception:
+                pass
 
     @property
     def ref(self):
         return self._ref
+
+
+class DeploymentResponseGenerator:
+    """Streaming response: iterates the items of a replica-side
+    generator, pulled one ``stream_next`` call at a time (lazy — the
+    replica generator only advances when the consumer asks)."""
+
+    def __init__(self, replica, stream_id: str,
+                 timeout_s: Optional[float] = None, on_done=None):
+        self._replica = replica
+        self._sid = stream_id
+        self._timeout = timeout_s
+        self._on_done = on_done
+        self._exhausted = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        if self._exhausted:
+            raise StopIteration
+        try:
+            out = ray_tpu.get(
+                self._replica.stream_next.remote(self._sid),
+                timeout=self._timeout)
+        except BaseException:
+            self._finish()
+            raise
+        if out.get("done"):
+            self._finish()
+            raise StopIteration
+        return out["item"]
+
+    def cancel(self):
+        """Abandon the stream (replica-side generator is closed)."""
+        if self._exhausted:
+            return
+        try:
+            self._replica.stream_cancel.remote(self._sid)
+        except Exception:
+            pass
+        self._finish()
+
+    def _finish(self):
+        self._exhausted = True
+        cb, self._on_done = self._on_done, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
 
 
 class DeploymentHandle:
@@ -68,6 +151,10 @@ class DeploymentHandle:
         self._rr = random.Random()
         self._listener_started = False
         self._stopped = False
+        # Pushed per-replica load (controller long-poll) + this handle's
+        # own optimistic in-flight deltas, keyed by actor id hex.
+        self._pushed_load: Dict[str, float] = {}
+        self._local_delta: Dict[str, int] = {}
 
     def __reduce__(self):
         # Handles travel into replicas (deployment graphs); the listener
@@ -88,6 +175,23 @@ class DeploymentHandle:
         threading.Thread(target=self._listen_loop, daemon=True,
                          name=f"serve-longpoll-{self.deployment_name}"
                          ).start()
+
+    def _install_update(self, value):
+        """A pushed replica-set update: either the legacy bare list or
+        ``{"replicas": [...], "ongoing": {aid: load}}``."""
+        if isinstance(value, dict):
+            replicas = list(value.get("replicas") or [])
+            ongoing = dict(value.get("ongoing") or {})
+        else:
+            replicas, ongoing = list(value), {}
+        with self._lock:
+            self._replicas = replicas
+            self._fetched_at = time.time()
+            self._pushed_load = ongoing
+            # The push reflects controller-observed load, which includes
+            # (or has retired) everything this handle submitted before
+            # the controller's probe — reset the optimistic deltas.
+            self._local_delta.clear()
 
     def _listen_loop(self):
         import ray_tpu
@@ -118,10 +222,8 @@ class DeploymentHandle:
                 continue
             failures = 0
             if key in updates:
-                version, replicas = updates[key]
-                with self._lock:
-                    self._replicas = list(replicas)
-                    self._fetched_at = time.time()
+                version, value = updates[key]
+                self._install_update(value)
         with self._lock:
             self._listener_started = False
 
@@ -154,8 +256,31 @@ class DeploymentHandle:
             self._replicas = replicas
             self._fetched_at = now
 
+    def _load_of(self, replica) -> float:
+        aid = _aid(replica)
+        return (self._pushed_load.get(aid, 0.0)
+                + self._local_delta.get(aid, 0))
+
+    def _note_submit(self, replica):
+        """Optimistic in-flight increment, undone when the response
+        resolves (or cleared wholesale by the next pushed snapshot)."""
+        aid = _aid(replica)
+        with self._lock:
+            self._local_delta[aid] = self._local_delta.get(aid, 0) + 1
+
+        def done():
+            with self._lock:
+                n = self._local_delta.get(aid, 0) - 1
+                if n > 0:
+                    self._local_delta[aid] = n
+                else:
+                    self._local_delta.pop(aid, None)
+
+        return done
+
     def _pick(self):
         import ray_tpu
+        from ray_tpu._private.config import config
 
         self._ensure_listener()
         self._refresh()
@@ -175,26 +300,53 @@ class DeploymentHandle:
                     f"{self.deployment_name!r}")
         if len(replicas) == 1:
             return replicas[0]
-        # Power of two choices on ongoing-request count.
+        # Power of two choices on per-replica load.
         a, b = self._rr.sample(replicas, 2)
-        try:
-            sa, sb = ray_tpu.get([a.stats.remote(), b.stats.remote()],
-                                 timeout=2)
-            return a if sa["ongoing"] <= sb["ongoing"] else b
-        except Exception:
-            return a
+        if config.serve_handle_stats_rpc:
+            # Legacy A/B baseline: two blocking stats RPCs per request.
+            try:
+                sa, sb = ray_tpu.get([a.stats.remote(), b.stats.remote()],
+                                     timeout=2)
+                return a if sa["ongoing"] <= sb["ongoing"] else b
+            except Exception:
+                return a
+        # Pushed loads + local optimistic deltas: zero RPCs.
+        with self._lock:
+            return a if self._load_of(a) <= self._load_of(b) else b
 
     def _submit(self, method: str, args, kwargs, fresh: bool = False):
         if fresh:
             self._refresh(force=True)
         replica = self._pick()
-        return replica.handle_request.remote(method, args, kwargs)
+        done = self._note_submit(replica)
+        return replica.handle_request.remote(method, args, kwargs), done
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        ref = self._submit(self._method, args, kwargs)
+        ref, done = self._submit(self._method, args, kwargs)
         return DeploymentResponse(
-            ref, resubmit=lambda: self._submit(self._method, args, kwargs,
-                                               fresh=True))
+            ref,
+            resubmit=lambda: self._submit(self._method, args, kwargs,
+                                          fresh=True)[0],
+            on_done=done)
+
+    def remote_gen(self, *args, **kwargs) -> DeploymentResponseGenerator:
+        return self._submit_stream(self._method, args, kwargs)
+
+    def _submit_stream(self, method: str, args,
+                       kwargs) -> DeploymentResponseGenerator:
+        import ray_tpu
+
+        replica = self._pick()
+        done = self._note_submit(replica)
+        try:
+            sid = ray_tpu.get(
+                replica.handle_request_stream.remote(method, args,
+                                                     kwargs),
+                timeout=_STREAM_START_TIMEOUT_S)
+        except BaseException:
+            done()
+            raise
+        return DeploymentResponseGenerator(replica, sid, on_done=done)
 
 
 class _MethodCaller:
@@ -203,7 +355,12 @@ class _MethodCaller:
         self._method = method
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        ref = self._handle._submit(self._method, args, kwargs)
+        ref, done = self._handle._submit(self._method, args, kwargs)
         return DeploymentResponse(
-            ref, resubmit=lambda: self._handle._submit(
-                self._method, args, kwargs, fresh=True))
+            ref,
+            resubmit=lambda: self._handle._submit(
+                self._method, args, kwargs, fresh=True)[0],
+            on_done=done)
+
+    def remote_gen(self, *args, **kwargs) -> DeploymentResponseGenerator:
+        return self._handle._submit_stream(self._method, args, kwargs)

@@ -1,0 +1,26 @@
+"""Disaggregated LLM serving on the device-object store.
+
+Continuous (in-flight) batching engine (``engine``), prefill / decode /
+combined deployment classes (``replicas``), KV-cache handoff over
+device objects (``kv_transfer``), and the router + app builder
+(``router``). See the README's "Serving LLMs" section for the
+architecture and knobs.
+"""
+
+from ray_tpu.serve.llm.engine import (  # noqa: F401
+    EngineConfig,
+    InflightBatchEngine,
+)
+from ray_tpu.serve.llm.kv_transfer import adopt_kv, publish_kv  # noqa: F401
+from ray_tpu.serve.llm.replicas import (  # noqa: F401
+    DecodeReplica,
+    LLMReplica,
+    PrefillReplica,
+)
+from ray_tpu.serve.llm.router import LLMRouter, build_llm_app  # noqa: F401
+
+__all__ = [
+    "EngineConfig", "InflightBatchEngine", "LLMReplica", "PrefillReplica",
+    "DecodeReplica", "LLMRouter", "build_llm_app", "publish_kv",
+    "adopt_kv",
+]

@@ -1,0 +1,515 @@
+"""Continuous (in-flight) batching engine for LLM serving.
+
+The engine owns one fixed-shape slotted batch (``models/generate.py``'s
+slotted programs: ``prefill_slot`` / ``adopt_slot`` / ``decode_step``)
+and a background scheduler thread that, between decode steps, admits
+queued requests into free slots and retires finished sequences. Static
+shapes mean XLA compiles once per (prompt bucket, slot count); requests
+join and leave the in-flight batch without retracing, and a request's
+tokens never depend on which other requests share the batch (per-request
+``fold_in`` sampling keys — the isolation contract).
+
+Two admission kinds feed the same batch:
+
+- ``submit``            — a raw prompt; the engine prefills it locally
+                          (the combined / continuous-batching pool).
+- ``submit_prefilled``  — a KV block prefilled elsewhere (the
+                          disaggregated decode pool; the block arrives
+                          as device-object refs and is spliced into a
+                          slot by the donated ``adopt_slot`` program).
+
+Consumers poll ``drain`` (bounded waits — one request), ``collect``
+(non-blocking, many requests per call: the high-QPS client path), or
+iterate ``stream`` (a generator of token chunks, the serve handle's
+streaming response path).
+
+Observability: ``serve_llm_queue_depth``, ``serve_llm_batch_occupancy``,
+``serve_llm_ttft_seconds`` and ``serve_llm_tokens_total`` flow through
+``ray_tpu.util.metrics`` to the dashboard's ``/metrics``, and
+``stats()['autoscale_load']`` (queue depth + busy slots) feeds the serve
+controller's queue-depth autoscaler.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+_IDLE_WAIT_S = 0.02       # scheduler nap when no slot is active
+_DRAIN_TICK_S = 0.25      # drain() wakes at least this often to re-check
+_STOP_JOIN_S = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Knobs of one engine (one replica). ``model_overrides`` is applied
+    on top of the ``GPTConfig`` preset — serving wants smaller/faster
+    variants of the training presets (fewer layers on the CPU test
+    platform, bf16 on TPU)."""
+
+    preset: str = "llama-tiny"
+    model_overrides: Tuple[Tuple[str, Any], ...] = ()
+    max_slots: int = 8
+    max_len: int = 256
+    prompt_buckets: Tuple[int, ...] = (16, 32, 64, 128)
+    max_new_tokens: int = 64          # default + hard cap per request
+    temperature: float = 0.0
+    top_k: int = 0
+    param_seed: int = 0
+    max_queue: int = 4096             # admission backpressure
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "EngineConfig":
+        if d is None:
+            return EngineConfig()
+        if isinstance(d, EngineConfig):
+            return d
+        d = dict(d)
+        if isinstance(d.get("model_overrides"), dict):
+            d["model_overrides"] = tuple(sorted(
+                d["model_overrides"].items()))
+        for k in ("prompt_buckets",):
+            if isinstance(d.get(k), list):
+                d[k] = tuple(d[k])
+        return EngineConfig(**d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["model_overrides"] = dict(self.model_overrides)
+        d["prompt_buckets"] = list(self.prompt_buckets)
+        return d
+
+    def gpt_config(self):
+        from ray_tpu.models import GPTConfig
+
+        overrides = dict(self.model_overrides)
+        if "dtype" in overrides and isinstance(overrides["dtype"], str):
+            import jax.numpy as jnp
+
+            overrides["dtype"] = getattr(jnp, overrides["dtype"])
+        return GPTConfig.preset(self.preset, **overrides)
+
+
+# ------------------------------------------------------------------ metrics
+
+_metrics_lock = threading.Lock()
+_metrics: Optional[Dict[str, Any]] = None
+
+
+def engine_metrics() -> Dict[str, Any]:
+    """Process-wide engine metric instruments (created once; several
+    engines in one process share them, distinguished by tags)."""
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+            tags = ("deployment", "replica")
+            _metrics = {
+                "queue_depth": Gauge(
+                    "serve_llm_queue_depth",
+                    "Requests admitted but not yet holding a batch slot.",
+                    tag_keys=tags),
+                "batch_occupancy": Gauge(
+                    "serve_llm_batch_occupancy",
+                    "Fraction of decode slots holding a live request.",
+                    tag_keys=tags),
+                "ttft": Histogram(
+                    "serve_llm_ttft_seconds",
+                    "Submit-to-first-token latency inside the engine.",
+                    tag_keys=tags),
+                "tokens": Counter(
+                    "serve_llm_tokens_total",
+                    "Tokens produced by the in-flight batching engine.",
+                    tag_keys=tags),
+            }
+        return _metrics
+
+
+class _Request:
+    __slots__ = ("id", "kind", "prompt", "budget", "seed", "kv",
+                 "first_token", "true_len", "tokens", "cursor", "done",
+                 "error", "t_submit", "t_first", "truncated")
+
+    def __init__(self, kind: str, *, prompt=None, budget: int = 0,
+                 seed: int = 0, kv=None, first_token: Optional[int] = None,
+                 true_len: int = 0):
+        self.id = uuid.uuid4().hex[:12]
+        self.kind = kind                  # "prompt" | "prefilled"
+        self.prompt = prompt
+        self.budget = budget              # total new tokens wanted
+        self.seed = seed
+        self.kv = kv                      # prefilled: {"k","v"} arrays
+        self.first_token = first_token
+        self.true_len = true_len          # prompt length (prefilled kind)
+        self.tokens: List[int] = []       # produced, pending consumption
+        self.cursor = 0
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.truncated = False
+
+
+class InflightBatchEngine:
+    """One slotted batch + its scheduler thread. Thread-safe: any thread
+    may submit/drain/collect; the scheduler thread owns the device state
+    and is the only one running compiled programs."""
+
+    def __init__(self, params, cfg, engine_cfg: EngineConfig,
+                 *, deployment: str = "llm", replica_id: str = "local"):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models.generate import init_slotted_cache
+
+        self._params = params
+        self._cfg = cfg
+        self._ec = engine_cfg
+        self._np = np
+        self._jnp = jnp
+        if engine_cfg.max_len > cfg.max_seq:
+            raise ValueError(
+                f"max_len {engine_cfg.max_len} > model max_seq "
+                f"{cfg.max_seq}")
+
+        B = engine_cfg.max_slots
+        self._cache = init_slotted_cache(cfg, B, engine_cfg.max_len)
+        self._slot_req: List[Optional[_Request]] = [None] * B
+        self._last_tokens = np.zeros((B,), np.int32)
+        self._active = np.zeros((B,), bool)
+        self._seeds = np.zeros((B,), np.int32)
+        self._produced = np.zeros((B,), np.int64)  # tokens emitted per slot
+
+        self._cv = threading.Condition()
+        self._pending: collections.deque = collections.deque()
+        self._requests: Dict[str, _Request] = {}
+        self._stopped = False
+        self._steps = 0
+
+        self._tags = {"deployment": deployment, "replica": replica_id}
+        self._m = engine_metrics()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"llm-engine-{deployment}-{replica_id}")
+        self._thread.start()
+
+    # ----------------------------------------------------------- admission
+
+    def _bucket_for(self, n: int) -> int:
+        for b in sorted(self._ec.prompt_buckets):
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt length {n} exceeds the largest prompt bucket "
+            f"{max(self._ec.prompt_buckets)}")
+
+    def _check_budget(self, prompt_len: int,
+                      max_new_tokens: Optional[int]) -> int:
+        budget = min(max_new_tokens or self._ec.max_new_tokens,
+                     self._ec.max_new_tokens)
+        if budget < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt_len + budget > self._ec.max_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({budget}) "
+                f"exceeds engine max_len {self._ec.max_len}")
+        return budget
+
+    def _enqueue(self, req: _Request) -> str:
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("engine is stopped")
+            if len(self._pending) >= self._ec.max_queue:
+                raise RuntimeError(
+                    f"engine queue full ({self._ec.max_queue})")
+            self._pending.append(req)
+            self._requests[req.id] = req
+            depth = len(self._pending)
+            self._cv.notify_all()
+        self._m["queue_depth"].set(depth, self._tags)
+        return req.id
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               seed: int = 0) -> str:
+        """Queue a raw prompt; returns a request id for drain/collect."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        self._bucket_for(len(prompt))   # validate against buckets now
+        budget = self._check_budget(len(prompt), max_new_tokens)
+        return self._enqueue(_Request(
+            "prompt", prompt=prompt, budget=budget, seed=int(seed)))
+
+    def submit_prefilled(self, first_token: int, kv: Dict[str, Any],
+                         true_len: int,
+                         max_new_tokens: Optional[int] = None,
+                         seed: int = 0) -> str:
+        """Queue a sequence prefilled elsewhere (disaggregated decode
+        pool). ``kv`` holds the bucket-sized K/V blocks ({"k","v"},
+        device arrays or host arrays freshly rebuilt off the arena);
+        ``first_token`` was sampled by the prefill pool and is NOT
+        re-emitted here — the engine produces tokens 2..budget."""
+        budget = self._check_budget(int(true_len), max_new_tokens)
+        return self._enqueue(_Request(
+            "prefilled", kv=kv, first_token=int(first_token),
+            true_len=int(true_len), budget=budget, seed=int(seed)))
+
+    # ----------------------------------------------------------- consumers
+
+    def drain(self, req_id: str, max_wait_s: float = 0.5
+              ) -> Dict[str, Any]:
+        """Pop the tokens produced since the last drain. Waits (bounded
+        by ``max_wait_s``) until at least one token or completion is
+        available; ``done`` rides the response that delivers the final
+        token, after which the request is forgotten."""
+        deadline = time.monotonic() + max(0.0, max_wait_s)
+        with self._cv:
+            while True:
+                req = self._requests.get(req_id)
+                if req is None:
+                    raise KeyError(f"unknown request {req_id!r}")
+                if req.error is not None:
+                    del self._requests[req_id]
+                    raise req.error
+                if req.cursor < len(req.tokens) or req.done:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(min(remaining, _DRAIN_TICK_S))
+            out = req.tokens[req.cursor:]
+            req.cursor = len(req.tokens)
+            done = req.done and req.cursor == len(req.tokens)
+            if done:
+                del self._requests[req_id]
+        return {"tokens": out, "done": done}
+
+    def collect(self, req_ids: Sequence[str]) -> Dict[str, Dict[str, Any]]:
+        """Non-blocking batched drain: one call serves many sessions
+        (the closed-loop load generator's path — RPC count scales with
+        poll rate, not with session count). Unknown ids report
+        ``{"error": "unknown"}`` (e.g. drained-to-done earlier)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._cv:
+            for rid in req_ids:
+                req = self._requests.get(rid)
+                if req is None:
+                    out[rid] = {"tokens": [], "done": True,
+                                "error": "unknown"}
+                    continue
+                if req.error is not None:
+                    out[rid] = {"tokens": [], "done": True,
+                                "error": repr(req.error)}
+                    del self._requests[rid]
+                    continue
+                toks = req.tokens[req.cursor:]
+                req.cursor = len(req.tokens)
+                done = req.done and req.cursor == len(req.tokens)
+                if done:
+                    del self._requests[rid]
+                out[rid] = {"tokens": toks, "done": done}
+        return out
+
+    def stream(self, req_id: str,
+               max_wait_s: float = 1.0) -> Iterator[List[int]]:
+        """Generator of token CHUNKS for one request: each item is
+        whatever accumulated since the last pull (>= 1 token, except
+        possibly the final empty completion)."""
+        while True:
+            out = self.drain(req_id, max_wait_s=max_wait_s)
+            if out["tokens"]:
+                yield out["tokens"]
+            if out["done"]:
+                return
+
+    def generate(self, prompt: Sequence[int],
+                 max_new_tokens: Optional[int] = None,
+                 seed: int = 0) -> List[int]:
+        """Blocking convenience: submit + drain to completion."""
+        rid = self.submit(prompt, max_new_tokens, seed)
+        return list(itertools.chain.from_iterable(self.stream(rid)))
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            queue = len(self._pending)
+            busy = int(self._active.sum())
+        return {
+            "queue_depth": queue,
+            "busy_slots": busy,
+            "max_slots": self._ec.max_slots,
+            "batch_occupancy": busy / self._ec.max_slots,
+            "autoscale_load": queue + busy,
+            "steps": self._steps,
+        }
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            for req in self._requests.values():
+                if not req.done and req.error is None:
+                    req.error = RuntimeError("engine stopped")
+            self._cv.notify_all()
+        self._thread.join(timeout=_STOP_JOIN_S)
+
+    # ----------------------------------------------------------- scheduler
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._stopped:
+                    return
+            try:
+                admitted = self._admit()
+                stepped = self._step()
+            except Exception as e:  # compile/runtime failure: fail loud,
+                self._poison(e)     # per-request, not a silent wedge
+                continue
+            if not admitted and not stepped:
+                with self._cv:
+                    if not self._pending and not self._active.any():
+                        self._cv.wait(_IDLE_WAIT_S)
+
+    def _poison(self, err: BaseException) -> None:
+        """A scheduler-side failure fails every in-flight request (the
+        callers see the real error) instead of wedging the loop."""
+        with self._cv:
+            for req in list(self._requests.values()):
+                if not req.done and req.error is None:
+                    req.error = err
+            self._pending.clear()
+            for i in range(len(self._slot_req)):
+                self._slot_req[i] = None
+            self._active[:] = False
+            self._cv.notify_all()
+
+    def _admit(self) -> bool:
+        """Move queued requests into free slots: prefill (or adopt) and
+        splice their KV into the batch cache. Compute runs OUTSIDE the
+        lock — only queue/slot bookkeeping is under it."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.generate import adopt_slot, prefill_slot
+
+        with self._cv:
+            free = self._free_slots()
+            take: List[Tuple[int, _Request]] = []
+            while free and self._pending:
+                take.append((free.pop(0), self._pending.popleft()))
+            if take:
+                self._m["queue_depth"].set(len(self._pending), self._tags)
+        if not take:
+            return False
+
+        for slot, req in take:
+            try:
+                if req.kind == "prompt":
+                    bucket = self._bucket_for(len(req.prompt))
+                    padded = self._np.zeros((1, bucket), self._np.int32)
+                    padded[0, :len(req.prompt)] = req.prompt
+                    first, kv = prefill_slot(
+                        self._params, jnp.asarray(padded),
+                        jnp.int32(len(req.prompt)), jnp.int32(req.seed),
+                        cfg=self._cfg, temperature=self._ec.temperature,
+                        top_k=self._ec.top_k)
+                    first_token = int(first[0])
+                    true_len = len(req.prompt)
+                    emit_first = True
+                else:
+                    kv = {"k": jnp.asarray(req.kv["k"]),
+                          "v": jnp.asarray(req.kv["v"])}
+                    first_token = req.first_token
+                    true_len = req.true_len
+                    req.kv = None      # drop the handoff reference early
+                    emit_first = False
+                self._cache = adopt_slot(
+                    self._cache, jnp.int32(slot), kv, jnp.int32(true_len))
+            except Exception as e:
+                with self._cv:
+                    req.error = e
+                    self._cv.notify_all()
+                continue
+
+            self._last_tokens[slot] = first_token
+            self._seeds[slot] = req.seed
+            self._active[slot] = True
+            self._produced[slot] = 1   # the prefill-sampled token
+            self._slot_req[slot] = req
+            now = time.monotonic()
+            with self._cv:
+                req.t_first = now
+                if emit_first:
+                    req.tokens.append(first_token)
+                if req.budget <= 1:
+                    self._retire_slot_locked(slot)
+                self._cv.notify_all()
+            self._m["ttft"].observe(now - req.t_submit, self._tags)
+            if emit_first:
+                self._m["tokens"].inc(1, self._tags)
+        self._m["batch_occupancy"].set(
+            float(self._active.sum()) / self._ec.max_slots, self._tags)
+        return True
+
+    def _retire_slot_locked(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        if req is not None:
+            req.done = True
+        self._slot_req[slot] = None
+        self._active[slot] = False
+
+    def _step(self) -> bool:
+        """One batched decode step; emit the new token of every active
+        slot and retire exhausted sequences."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.generate import decode_step
+
+        if not self._active.any():
+            return False
+        nxt, self._cache = decode_step(
+            self._params, self._cache,
+            jnp.asarray(self._last_tokens), jnp.asarray(self._active),
+            jnp.asarray(self._seeds), cfg=self._cfg,
+            temperature=self._ec.temperature, top_k=self._ec.top_k)
+        nxt = self._np.asarray(nxt)       # the per-step host sync
+        self._steps += 1
+
+        emitted = 0
+        retired = False
+        with self._cv:
+            for slot, req in enumerate(self._slot_req):
+                if req is None or not self._active[slot]:
+                    continue
+                token = int(nxt[slot])
+                self._last_tokens[slot] = token
+                self._produced[slot] += 1
+                req.tokens.append(token)
+                emitted += 1
+                full = req.true_len if req.kind == "prefilled" \
+                    else len(req.prompt)
+                cache_full = full + self._produced[slot] >= \
+                    self._ec.max_len
+                if cache_full and self._produced[slot] < req.budget:
+                    req.truncated = True
+                if self._produced[slot] >= req.budget or cache_full:
+                    self._retire_slot_locked(slot)
+                    retired = True
+            self._cv.notify_all()
+        if emitted:
+            self._m["tokens"].inc(emitted, self._tags)
+        if retired:
+            self._m["batch_occupancy"].set(
+                float(self._active.sum()) / self._ec.max_slots,
+                self._tags)
+        return True

@@ -1,0 +1,55 @@
+"""KV-cache handoff between prefill and decode pools over device objects.
+
+The prefill replica publishes its bucket-sized K/V blocks with
+``ray_tpu.put`` — one ref per tensor, so each leaf rides PR 2's
+device-object path end to end:
+
+- **same process** (combined replica, tests, the in-bench probe): the
+  get is served from the per-CoreWorker weak-value registry — the
+  ORIGINAL array, by reference; the cache never leaves HBM and the
+  device-object probe counts zero host materializations.
+- **same host, different process**: put stages the device buffer once
+  into the arena slab; the decode side's get rebuilds zero-copy off the
+  read-only arena view (on CPU XLA aliases the pages outright).
+- **cross host**: the ref resolves through the existing arena OOB
+  chunked-pull path — the only copy beyond the two DMAs is the wire.
+
+The handoff descriptor itself is a small dict (two refs + scalars) that
+travels through the serve handle like any argument; the refs are pinned
+by the descriptor until the decode engine has spliced the block into its
+batch cache and dropped them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+_GET_TIMEOUT_S = 60.0
+
+
+def publish_kv(kv: Dict[str, Any], true_len: int,
+               first_token: int, **meta: Any) -> Dict[str, Any]:
+    """Stage one prefilled KV block into the object store and return the
+    handoff descriptor handed to the decode pool."""
+    import ray_tpu
+
+    out = {
+        "k_ref": ray_tpu.put(kv["k"]),
+        "v_ref": ray_tpu.put(kv["v"]),
+        "length": int(true_len),
+        "first_token": int(first_token),
+    }
+    out.update(meta)
+    return out
+
+
+def adopt_kv(handoff: Dict[str, Any]) -> Dict[str, Any]:
+    """Resolve a handoff descriptor back into K/V arrays. By-reference
+    when this process produced them; arena-backed ``device_put`` rebuild
+    otherwise. Bounded: a dead prefill replica must fail the request,
+    not wedge the decode engine's admission path."""
+    import ray_tpu
+
+    k, v = ray_tpu.get([handoff["k_ref"], handoff["v_ref"]],
+                       timeout=_GET_TIMEOUT_S)
+    return {"k": k, "v": v}

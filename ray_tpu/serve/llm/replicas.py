@@ -1,0 +1,237 @@
+"""Deployment classes for the LLM serving tier.
+
+Three pool shapes over one engine substrate:
+
+- ``LLMReplica``      — combined prefill+decode with continuous
+                        batching (one pool; the A/B winner over
+                        one-request-per-call replicas).
+- ``PrefillReplica``  — prompt-only pool: runs the big prefill matmuls,
+                        samples the first token, publishes the KV block
+                        as device-object refs (``kv_transfer``).
+- ``DecodeReplica``   — decode-only pool: adopts prefilled KV blocks
+                        into its in-flight batch and streams the
+                        remaining tokens.
+
+Each exposes ``serve_stats`` so the generic serve replica wrapper
+reports the engine's queue depth / slot occupancy to the controller —
+the ``autoscale_load`` the queue-depth autoscaler sizes the pool by —
+and starts the process metrics reporter so the engine gauges reach the
+dashboard's ``/metrics``.
+
+On TPU hosts, pin replicas to chips with
+``ray_actor_options={"num_tpus": N}`` in the deployment config; each
+replica then compiles its programs against its own chip set.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+from ray_tpu.serve.llm.engine import EngineConfig, InflightBatchEngine
+
+_reporter_lock = threading.Lock()
+_reporter_started = False
+
+
+def _ensure_metrics_reporter() -> None:
+    """One metrics-push thread per replica process (idempotent)."""
+    global _reporter_started
+    with _reporter_lock:
+        if _reporter_started:
+            return
+        from ray_tpu.util import metrics
+
+        metrics.start_reporter(period_s=2.0)
+        _reporter_started = True
+
+
+def normalize_request(request: Any) -> Dict[str, Any]:
+    """Accept either the direct dict ``{"prompt": [ids], "n": int,
+    "seed": int}`` or the HTTP proxy payload (``{"json": {...}}``)."""
+    if isinstance(request, dict) and "json" in request \
+            and isinstance(request["json"], dict):
+        request = request["json"]
+    if not isinstance(request, dict) or "prompt" not in request:
+        raise ValueError(
+            "LLM request must be a dict with a 'prompt' token list "
+            f"(got {type(request).__name__})")
+    return {
+        "prompt": [int(t) for t in request["prompt"]],
+        "n": int(request["n"]) if request.get("n") else None,
+        "seed": int(request.get("seed") or 0),
+    }
+
+
+def _build_model(ec: EngineConfig):
+    import jax
+
+    from ray_tpu.models import init_params
+
+    cfg = ec.gpt_config()
+    params = init_params(jax.random.key(ec.param_seed), cfg)
+    return cfg, params
+
+
+def _replica_tag() -> str:
+    """This replica's actor id for metric tags ("local" outside a
+    cluster, e.g. engine unit tests constructing replicas directly)."""
+    try:
+        import ray_tpu
+
+        return ray_tpu.get_runtime_context().get_actor_id() or "local"
+    except Exception:
+        return "local"
+
+
+class LLMReplica:
+    """Combined pool: one continuous-batching engine per replica."""
+
+    def __init__(self, engine_config: Optional[Dict[str, Any]] = None):
+        ec = EngineConfig.from_dict(engine_config)
+        cfg, params = _build_model(ec)
+        self._engine = InflightBatchEngine(
+            params, cfg, ec, deployment="llm", replica_id=_replica_tag())
+        _ensure_metrics_reporter()
+
+    def __call__(self, request: Any) -> Dict[str, Any]:
+        req = normalize_request(request)
+        tokens = self._engine.generate(req["prompt"], req["n"],
+                                       req["seed"])
+        return {"tokens": tokens}
+
+    def generate_stream(self, request: Any) -> Iterator[List[int]]:
+        """Generator of token chunks (the handle's streaming path)."""
+        req = normalize_request(request)
+        rid = self._engine.submit(req["prompt"], req["n"], req["seed"])
+        return self._engine.stream(rid)
+
+    # Decoupled submit/poll API: the high-QPS client path (one collect
+    # RPC serves every session parked on this replica).
+    def submit(self, request: Any) -> str:
+        req = normalize_request(request)
+        return self._engine.submit(req["prompt"], req["n"], req["seed"])
+
+    def drain(self, req_id: str, max_wait_s: float = 0.5):
+        return self._engine.drain(req_id, max_wait_s)
+
+    def collect(self, req_ids: List[str]):
+        return self._engine.collect(req_ids)
+
+    def serve_stats(self) -> Dict[str, Any]:
+        return self._engine.stats()
+
+    def check_health(self) -> bool:
+        return True
+
+    def __del__(self):
+        eng = getattr(self, "_engine", None)
+        if eng is not None:
+            eng.stop()
+
+
+class PrefillReplica:
+    """Prompt-only pool: one prefill per call (prefill is one large
+    batched matmul — request-level concurrency across replicas is the
+    scaling axis here, driven by this pool's own autoscaler)."""
+
+    def __init__(self, engine_config: Optional[Dict[str, Any]] = None):
+        self._ec = EngineConfig.from_dict(engine_config)
+        self._cfg, self._params = _build_model(self._ec)
+        self._lock = threading.Lock()
+        _ensure_metrics_reporter()
+
+    def _bucket_for(self, n: int) -> int:
+        for b in sorted(self._ec.prompt_buckets):
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt length {n} exceeds the largest prompt bucket "
+            f"{max(self._ec.prompt_buckets)}")
+
+    def prefill(self, request: Any) -> Dict[str, Any]:
+        """Run the prompt, sample the first token, publish the KV block
+        as device-object refs. Returns the handoff descriptor the router
+        forwards to the decode pool."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models.generate import prefill_slot
+        from ray_tpu.serve.llm.kv_transfer import publish_kv
+
+        req = normalize_request(request)
+        prompt = req["prompt"]
+        if not prompt:
+            raise ValueError("empty prompt")
+        bucket = self._bucket_for(len(prompt))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        # jit dispatch is not thread-safe against itself for donated
+        # caches; prefill has no donation but serialize anyway — one
+        # prefill at a time per replica keeps the chip program simple.
+        with self._lock:
+            first, kv = prefill_slot(
+                self._params, jnp.asarray(padded),
+                jnp.int32(len(prompt)), jnp.int32(req["seed"]),
+                cfg=self._cfg, temperature=self._ec.temperature,
+                top_k=self._ec.top_k)
+        return publish_kv(
+            kv, len(prompt), int(first[0]),
+            n=req["n"], seed=req["seed"])
+
+    def serve_stats(self) -> Dict[str, Any]:
+        return {}
+
+    def check_health(self) -> bool:
+        return True
+
+
+class DecodeReplica:
+    """Decode-only pool: adopts prefilled KV blocks into the in-flight
+    batch. The first token was already sampled (and delivered) by the
+    prefill pool; this engine streams tokens 2..n."""
+
+    def __init__(self, engine_config: Optional[Dict[str, Any]] = None):
+        ec = EngineConfig.from_dict(engine_config)
+        cfg, params = _build_model(ec)
+        self._engine = InflightBatchEngine(
+            params, cfg, ec, deployment="llm-decode",
+            replica_id=_replica_tag())
+        _ensure_metrics_reporter()
+
+    def submit_prefilled(self, handoff: Dict[str, Any]) -> str:
+        from ray_tpu.serve.llm.kv_transfer import adopt_kv
+
+        kv = adopt_kv(handoff)
+        return self._engine.submit_prefilled(
+            handoff["first_token"], kv, handoff["length"],
+            handoff.get("n"), handoff.get("seed") or 0)
+
+    def decode(self, handoff: Dict[str, Any]) -> Dict[str, Any]:
+        """Blocking: the remaining tokens (2..n) for one handoff."""
+        rid = self.submit_prefilled(handoff)
+        tokens: List[int] = []
+        for chunk in self._engine.stream(rid):
+            tokens.extend(chunk)
+        return {"tokens": tokens}
+
+    def decode_stream(self, handoff: Dict[str, Any]) -> Iterator[List[int]]:
+        rid = self.submit_prefilled(handoff)
+        return self._engine.stream(rid)
+
+    def drain(self, req_id: str, max_wait_s: float = 0.5):
+        return self._engine.drain(req_id, max_wait_s)
+
+    def collect(self, req_ids: List[str]):
+        return self._engine.collect(req_ids)
+
+    def serve_stats(self) -> Dict[str, Any]:
+        return self._engine.stats()
+
+    def check_health(self) -> bool:
+        return True
+
+    def __del__(self):
+        eng = getattr(self, "_engine", None)
+        if eng is not None:
+            eng.stop()

@@ -1,0 +1,132 @@
+"""LLM router deployment + application builder.
+
+The router is a thin deployment that owns the pool handles and exposes
+one ``/llm`` route: it sequences prefill -> KV handoff -> decode in
+disaggregated mode, or forwards to the combined pool. The heavy state
+(params, KV cache) lives in the pools; routers are stateless and cheap
+to replicate.
+
+``build_llm_app`` assembles the deployment graph with ``.bind()`` —
+children (pools) deploy first and the router receives live
+DeploymentHandles, exactly like any multi-deployment serve app.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+from ray_tpu.serve.llm.engine import EngineConfig
+from ray_tpu.serve.llm.replicas import (
+    DecodeReplica, LLMReplica, PrefillReplica, normalize_request,
+)
+
+# Upper bound on one request's end-to-end residence: queueing (a cold
+# autoscaled replica compiles its programs under load) + generation.
+_ROUTER_TIMEOUT_S = 600.0
+
+
+class LLMRouter:
+    """Sequences one request across the pools. Mode is implied by which
+    handles were bound: (prefill, decode) or a single combined pool."""
+
+    def __init__(self, prefill=None, decode=None, llm=None):
+        if llm is None and (prefill is None or decode is None):
+            raise ValueError(
+                "LLMRouter needs either llm= (combined) or both "
+                "prefill= and decode= handles")
+        self._prefill = prefill
+        self._decode = decode
+        self._llm = llm
+
+    def __call__(self, request: Any) -> Dict[str, Any]:
+        req = normalize_request(request)
+        if self._llm is not None:
+            return self._llm.remote(req).result(
+                timeout=_ROUTER_TIMEOUT_S)
+        handoff = self._prefill.prefill.remote(req).result(
+            timeout=_ROUTER_TIMEOUT_S)
+        if (handoff.get("n") or 2) <= 1:
+            return {"tokens": [handoff["first_token"]]}
+        rest = self._decode.decode.remote(handoff).result(
+            timeout=_ROUTER_TIMEOUT_S)
+        return {"tokens": [handoff["first_token"]] + rest["tokens"]}
+
+    def generate_stream(self, request: Any) -> Iterator[List[int]]:
+        """Streaming: yields token chunks. In disaggregated mode the
+        first chunk is the prefill pool's token (the TTFT token); the
+        rest stream from the decode pool as produced."""
+        req = normalize_request(request)
+        if self._llm is not None:
+            return self._llm.generate_stream.remote_gen(req)
+        return self._stream_disagg(req)
+
+    def _stream_disagg(self, req: Dict[str, Any]) -> Iterator[List[int]]:
+        handoff = self._prefill.prefill.remote(req).result(
+            timeout=_ROUTER_TIMEOUT_S)
+        yield [handoff["first_token"]]
+        if (handoff.get("n") or 2) <= 1:
+            return
+        for chunk in self._decode.decode_stream.remote_gen(handoff):
+            yield chunk
+
+    def check_health(self) -> bool:
+        return True
+
+
+def build_llm_app(engine_config: Optional[Dict[str, Any]] = None, *,
+                  mode: str = "disaggregated",
+                  name: str = "llm",
+                  num_router_replicas: int = 1,
+                  num_replicas: int = 1,
+                  num_prefill_replicas: int = 1,
+                  num_decode_replicas: int = 1,
+                  autoscaling_config=None,
+                  prefill_autoscaling=None,
+                  decode_autoscaling=None,
+                  max_ongoing_requests: int = 2048,
+                  ray_actor_options: Optional[Dict[str, Any]] = None):
+    """Build the LLM serving application.
+
+    mode="disaggregated": PrefillReplica + DecodeReplica pools behind
+    the router (KV handoff over device objects). mode="combined": one
+    continuous-batching pool. Autoscaling configs apply per pool; the
+    engine pools scale on queue depth + slot occupancy
+    (``autoscale_load``), the prefill pool on in-flight requests.
+    """
+    from ray_tpu import serve
+
+    ec = EngineConfig.from_dict(engine_config)
+    ec_dict = ec.to_dict()
+    opts = dict(ray_actor_options or {})
+
+    if mode == "combined":
+        pool = serve.deployment(
+            LLMReplica, name=f"{name}-engine",
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=opts).bind(ec_dict)
+        return serve.deployment(
+            LLMRouter, name=name,
+            num_replicas=num_router_replicas,
+            max_ongoing_requests=max_ongoing_requests).bind(llm=pool)
+    if mode != "disaggregated":
+        raise ValueError(f"unknown mode {mode!r} "
+                         "(want 'disaggregated' or 'combined')")
+    prefill = serve.deployment(
+        PrefillReplica, name=f"{name}-prefill",
+        num_replicas=num_prefill_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+        autoscaling_config=prefill_autoscaling,
+        ray_actor_options=opts).bind(ec_dict)
+    decode = serve.deployment(
+        DecodeReplica, name=f"{name}-decode",
+        num_replicas=num_decode_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+        autoscaling_config=decode_autoscaling,
+        ray_actor_options=opts).bind(ec_dict)
+    return serve.deployment(
+        LLMRouter, name=name,
+        num_replicas=num_router_replicas,
+        max_ongoing_requests=max_ongoing_requests).bind(
+        prefill=prefill, decode=decode)

@@ -3,6 +3,17 @@
 
 Wraps the user's class or function. Tracks in-flight request count for
 queue-depth autoscaling and handle-side least-loaded routing.
+
+Async deployments get ONE persistent background event loop per replica
+(reference: the replica's user-code event loop) — coroutines from every
+request run on the same loop, so async state (locks, queues, client
+sessions) shared across requests works; the old per-request
+``asyncio.run`` created and destroyed a loop per call.
+
+Streaming deployments return a generator (sync or async):
+``handle_request_stream`` registers it and ``stream_next`` pulls one
+item per call, driven lazily by the consumer through the handle's
+``remote_gen`` path — natural backpressure, no unbounded buffering.
 """
 
 from __future__ import annotations
@@ -10,7 +21,27 @@ from __future__ import annotations
 import asyncio
 import inspect
 import threading
-from typing import Any, Dict, Optional, Tuple
+import uuid
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+
+class _AsyncGenIter:
+    """Drive an async generator from sync code via the replica loop."""
+
+    def __init__(self, agen, loop):
+        self._agen = agen
+        self._loop = loop
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        fut = asyncio.run_coroutine_threadsafe(
+            self._agen.__anext__(), self._loop)
+        try:
+            return fut.result()
+        except StopAsyncIteration:
+            raise StopIteration from None
 
 
 class Replica:
@@ -25,6 +56,14 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
+        # One persistent event loop for the replica's async user code.
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True,
+            name=f"replica-loop-{replica_id}")
+        self._loop_thread.start()
+        # Live streams: id -> {"iter", "lock"}.
+        self._streams: Dict[str, Dict[str, Any]] = {}
         if inspect.isclass(target):
             self._instance = target(*init_args, **init_kwargs)
             self._callable = self._instance
@@ -44,27 +83,128 @@ class Replica:
             fn(user_config)
         return True
 
+    def _resolve(self, method_name: str):
+        if method_name == "__call__":
+            return self._callable
+        return getattr(self._callable, method_name)
+
+    def _run_user_code(self, method_name: str, args: Tuple, kwargs: Dict):
+        result = self._resolve(method_name)(*args, **kwargs)
+        if inspect.iscoroutine(result):
+            # Submit to the replica's persistent loop — NOT a fresh
+            # asyncio.run() loop per call, which broke any deployment
+            # sharing async state across requests.
+            result = asyncio.run_coroutine_threadsafe(
+                result, self._loop).result()
+        return result
+
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
-            if method_name == "__call__":
-                fn = self._callable
-            else:
-                fn = getattr(self._callable, method_name)
-            result = fn(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = asyncio.run(result)
+            result = self._run_user_code(method_name, args, kwargs)
+            if inspect.isgenerator(result) or \
+                    inspect.isasyncgen(result):
+                raise TypeError(
+                    f"{self.deployment_name}.{method_name} returned a "
+                    "generator; call it through the handle's "
+                    "remote_gen() streaming path")
             return result
         finally:
             with self._lock:
                 self._ongoing -= 1
 
-    def stats(self) -> Dict[str, Any]:
+    # ------------------------------------------------------------ streaming
+
+    def handle_request_stream(self, method_name: str, args: Tuple,
+                              kwargs: Dict) -> str:
+        """Start a streaming response: the user method must return a
+        generator / async generator / iterator. Returns the stream id
+        the caller pulls with ``stream_next``. The stream counts as one
+        ongoing request until exhausted (autoscaling signal)."""
         with self._lock:
-            return {"ongoing": self._ongoing, "total": self._total,
-                    "replica_id": self.replica_id}
+            self._ongoing += 1
+            self._total += 1
+        try:
+            result = self._run_user_code(method_name, args, kwargs)
+            if inspect.isasyncgen(result):
+                it = _AsyncGenIter(result, self._loop)
+            elif inspect.isgenerator(result) or hasattr(
+                    result, "__next__"):
+                it = result
+            else:
+                raise TypeError(
+                    f"{self.deployment_name}.{method_name} returned "
+                    f"{type(result).__name__}, not a generator/iterator")
+        except BaseException:
+            with self._lock:
+                self._ongoing -= 1
+            raise
+        sid = uuid.uuid4().hex[:12]
+        with self._lock:
+            self._streams[sid] = {"iter": it, "lock": threading.Lock()}
+        return sid
+
+    def stream_next(self, stream_id: str) -> Dict[str, Any]:
+        """Pull the next item of a stream. ``{"item": x, "done": False}``
+        or ``{"done": True}`` at exhaustion (the stream is then
+        forgotten). Errors from the generator tear the stream down and
+        propagate to the caller."""
+        with self._lock:
+            st = self._streams.get(stream_id)
+        if st is None:
+            return {"done": True}
+        try:
+            with st["lock"]:
+                item = next(st["iter"])
+            return {"item": item, "done": False}
+        except StopIteration:
+            self._drop_stream(stream_id)
+            return {"done": True}
+        except BaseException:
+            self._drop_stream(stream_id)
+            raise
+
+    def stream_cancel(self, stream_id: str) -> bool:
+        """Abandon a stream (consumer went away)."""
+        with self._lock:
+            st = self._streams.get(stream_id)
+        if st is None:
+            return False
+        it = st["iter"]
+        close = getattr(it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+        self._drop_stream(stream_id)
+        return True
+
+    def _drop_stream(self, stream_id: str) -> None:
+        with self._lock:
+            if self._streams.pop(stream_id, None) is not None:
+                self._ongoing -= 1
+
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        # Deployments exposing ``serve_stats()`` (e.g. the LLM engine
+        # pools) merge engine-side signals — queue depth, slot
+        # occupancy, and ``autoscale_load``, the number the queue-depth
+        # autoscaler and the handle's pushed-stats router weigh.
+        extra: Dict[str, Any] = {}
+        fn = getattr(self._instance, "serve_stats", None)
+        if fn is not None:
+            try:
+                extra = dict(fn() or {})
+            except Exception:
+                extra = {}
+        with self._lock:
+            extra.update({"ongoing": self._ongoing, "total": self._total,
+                          "replica_id": self.replica_id})
+        return extra
 
     def check_health(self) -> bool:
         fn = getattr(self._instance, "check_health", None)

@@ -43,6 +43,7 @@ LOCKDEP_MODULES = {
     "test_local_scheduler",
     "test_gang_fault_tolerance",
     "test_device_objects",
+    "test_serve_llm",
 }
 
 
