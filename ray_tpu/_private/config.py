@@ -308,6 +308,18 @@ _d("gcs_recovery_grace_s", 10.0,
 _d("maximum_gcs_dead_node_cache", 100, "Dead nodes kept for the state API.")
 _d("task_events_max_buffer", 10000, "Per-worker task event buffer entries.")
 
+# --- observability (per-node agent) -----------------------------------------
+_d("flight_recorder_events", 4096,
+   "Ring-buffer capacity of the per-node flight recorder (recent task "
+   "events/spans, hardware samples, worker lifecycle events). The ring "
+   "auto-dumps to <session_dir>/flight_recorder/ when a worker dies "
+   "unexpectedly or a gang supervisor declares slice death, so every "
+   "gang restart leaves a postmortem artifact.")
+_d("agent_stack_timeout_s", 5.0,
+   "Bound on one cluster-wide in-band stack capture (ray_tpu stack): "
+   "per-worker dump_stacks RPCs are fanned out in parallel and workers "
+   "that cannot answer within it are reported as errors, not waited on.")
+
 # --- tpu --------------------------------------------------------------------
 _d("tpu_chips_per_host", 4,
    "Chips driven by one host on the modeled pod (v4/v5p default).")

@@ -43,10 +43,13 @@ from __future__ import annotations
 import pickle
 import sys
 import threading
+import time
 import weakref
 from typing import Any, Optional
 
 import msgpack
+
+from ray_tpu.util import tracing
 
 _install_lock = threading.Lock()
 _installed = False
@@ -194,6 +197,7 @@ def _reduce_device_array(obj):
             return None  # let default pickling raise its own error
     except Exception:
         pass
+    _t0 = time.time()
     np_val = _host_view(obj)
     header = msgpack.packb({
         "v": 1,
@@ -206,6 +210,16 @@ def _reduce_device_array(obj):
     _tls.pending_stage_bytes += nbytes
     _bump("puts")
     _bump("staged_bytes", nbytes)
+    # Staging span: the device->host hop of a device-object put (the KV
+    # handoff's publish side) joins the task-event trace under whatever
+    # task/handle span is staging it. Gated on an active trace context:
+    # an orphan span (driver-side put outside any task) carries no
+    # connectivity and would only churn the task-event ring.
+    if tracing.current() is not None:
+        tracing.emit_span("device_object.put", kind="device_put",
+                          start=_t0,
+                          attrs={"bytes": int(nbytes),
+                                 "shape": list(np_val.shape)})
     # Extended ML dtypes (bfloat16/float8) cannot export the buffer
     # protocol — ship their raw bytes instead (still a view, not a copy;
     # the header carries the true dtype for the rebuild).
@@ -277,6 +291,7 @@ def rebuild_device_array(header: bytes, buf):
     """
     import numpy as np
 
+    _t0 = time.time()
     meta = msgpack.unpackb(header)
     np_view = np.frombuffer(buf, dtype=_resolve_dtype(meta["dtype"]))
     np_view = np_view.reshape(meta["shape"])
@@ -291,6 +306,14 @@ def rebuild_device_array(header: bytes, buf):
     except Exception:
         return np_view  # backend initialization failed: numpy fallback
     _bump("rebuilds")
+    # Rebuild span: the host->device hop of a device-object get (the KV
+    # handoff's adopt side). Context-gated like the put span.
+    if tracing.current() is not None:
+        tracing.emit_span("device_object.get", kind="device_get",
+                          start=_t0,
+                          attrs={"bytes": int(np_view.nbytes),
+                                 "shape": list(meta["shape"]),
+                                 "local_hit": False})
     # Pin: the finalizer owns (buf, np_view) until ``arr`` is collected.
     # Required even off-CPU — device_put is asynchronous, and on CPU XLA
     # aliases the aligned arena pages outright.
@@ -333,6 +356,17 @@ def lookup_local(core, oid_bytes: bytes) -> Optional[Any]:
             pass
         return None  # fall back to the arena rebuild
     _bump("local_hits")
+    # Zero-copy by-reference hit: still a trace point (the same-process
+    # KV handoff leg) — but ONLY under an active trace context. This is
+    # the 2.1 us hot path (MICROBENCH device_get_local_ms); outside a
+    # task/span (benchmark drivers, plain gets) the cost is one
+    # contextvar read and no event is built.
+    if tracing.current() is not None:
+        _now = time.time()
+        tracing.emit_span("device_object.get", kind="device_get",
+                          start=_now, end=_now,
+                          attrs={"bytes": int(getattr(arr, "nbytes", 0)),
+                                 "local_hit": True})
     return arr
 
 

@@ -1845,12 +1845,85 @@ class GcsServer:
 
     def _h_dump_stacks(self, conn, p, msg_id):
         """Fan a stack-dump request out to every node (reference: the
-        `ray stack` CLI, scripts.py; dumps surface via the log stream)."""
+        `ray stack` CLI, scripts.py; dumps surface via the log stream).
+        Legacy SIGUSR2 path; the in-band data path is collect_stacks."""
         with self._lock:
             nodes = [n for n in self._nodes.values() if n.alive]
         for n in nodes:
             try:
                 n.conn.notify("dump_stacks")
+            except Exception:
+                pass
+        conn.reply(msg_id, len(nodes))
+
+    # ------------------------------------------- per-node agent fan-in
+    # (reference: dashboard/state_aggregator fan-out to per-node agents;
+    # here the GCS holds the node conns, so it IS the fan-in hop)
+
+    def _agent_nodes(self, node_filter: Optional[str]):
+        with self._lock:
+            return [(n.node_id, n.conn) for n in self._nodes.values()
+                    if n.alive and (not node_filter
+                                    or n.node_id.startswith(node_filter))]
+
+    def _agent_fanout(self, conn, msg_id, mtype: str, payload: dict,
+                      nodes, timeout_s: float):
+        """Fan ``mtype`` out to the node managers and reply with the
+        collected per-node results. Runs OFF the caller conn's serve
+        thread (node replies take up to ``timeout_s``), with every
+        per-node wait bounded."""
+        def run():
+            out = []
+            for nid, ok, reply in protocol.fanout_requests(
+                    nodes, mtype, payload, timeout_s + 2.0):
+                out.append(reply if ok else
+                           {"node_id": nid, "error": reply})
+            try:
+                conn.reply(msg_id, out)
+            except Exception:
+                pass
+
+        threading.Thread(target=run, daemon=True,
+                         name="rtpu-gcs-agent").start()
+
+    def _h_collect_stacks(self, conn, p, msg_id):
+        """Cluster-wide in-band stack capture: every node agent snapshots
+        ``sys._current_frames()`` across its workers and the results fan
+        back in as data (`ray_tpu stack` — no signals, no log scraping)."""
+        p = p or {}
+        from ray_tpu._private.config import config as _cfg
+
+        timeout_s = float(p.get("timeout_s")
+                          or _cfg.agent_stack_timeout_s)
+        nodes = self._agent_nodes(p.get("node_id"))
+        self._agent_fanout(conn, msg_id, "collect_stacks",
+                           {"timeout_s": timeout_s}, nodes, timeout_s)
+
+    def _h_agent_logs(self, conn, p, msg_id):
+        """Per-worker log tail/listing with head fan-in. An actor_id
+        filter routes to the hosting node only; everything else fans to
+        all nodes and lets each agent match locally."""
+        p = dict(p or {})
+        nodes = self._agent_nodes(p.pop("node_id", None))
+        aid = p.get("actor_id")
+        if aid:
+            with self._lock:
+                homes = {e.node_id for a, e in self._actors.items()
+                         if a.hex().startswith(aid) and e.node_id}
+            if homes:
+                nodes = [(nid, c) for nid, c in nodes if nid in homes]
+        self._agent_fanout(conn, msg_id, "agent_logs", p, nodes,
+                           timeout_s=10.0)
+
+    def _h_flight_dump(self, conn, p, msg_id):
+        """Trigger a flight-recorder dump on every node (the gang
+        supervisor calls this when it declares slice death, so each
+        restart leaves per-node postmortem artifacts)."""
+        nodes = self._agent_nodes((p or {}).get("node_id"))
+        for _nid, nconn in nodes:
+            try:
+                nconn.notify("flight_dump",
+                             {"reason": (p or {}).get("reason")})
             except Exception:
                 pass
         conn.reply(msg_id, len(nodes))
@@ -1937,6 +2010,10 @@ class GcsServer:
             for ev in reversed(self._task_events):
                 if len(out) >= limit:
                     break
+                # Intra-task spans (serve hops, collectives, device
+                # transfers) share the event stream but are not tasks.
+                if ev.get("kind") not in ("task", "actor_task"):
+                    continue
                 if ev["task_id"] in listed:
                     continue
                 listed.add(ev["task_id"])
@@ -1983,17 +2060,28 @@ class GcsServer:
         stale_cutoff = time.time() - 300
         with self._lock:
             self._metrics[p["client_id"]] = {
-                "samples": p["samples"], "ts": p["ts"]}
+                "samples": p["samples"], "ts": p["ts"],
+                "period_s": p.get("period_s")}
             # Prune long-dead reporters so the table stays bounded.
             for cid in [c for c, m in self._metrics.items()
                         if m["ts"] < stale_cutoff]:
                 del self._metrics[cid]
 
     def _h_get_metrics(self, conn, p, msg_id):
-        cutoff = time.time() - 120
+        """Live sample groups only. A client's series expire once it
+        missed ≥3 of its own reporting periods OR its connection is gone
+        (worker death / replica downscale) — a killed LLM replica's
+        gauges must not report stale queue depths forever."""
+        now = time.time()
         with self._lock:
-            groups = [m["samples"] for m in self._metrics.values()
-                      if m["ts"] > cutoff]
+            groups = []
+            for cid, m in list(self._metrics.items()):
+                period = float(m.get("period_s") or 5.0)
+                if cid not in self._clients or \
+                        now - m["ts"] > 3.0 * period:
+                    del self._metrics[cid]
+                    continue
+                groups.append(m["samples"])
             conn.reply(msg_id, groups)
 
     def _h_pending_demand(self, conn, p, msg_id):
@@ -2030,6 +2118,8 @@ class GcsServer:
         with self._lock:
             by_name: Dict[str, Dict[str, int]] = {}
             for ev in self._task_events:
+                if ev.get("kind") not in ("task", "actor_task"):
+                    continue   # span events are not tasks
                 d = by_name.setdefault(ev["name"], {})
                 k = "FINISHED" if ev["status"] == "ok" else "FAILED"
                 d[k] = d.get(k, 0) + 1

@@ -206,6 +206,14 @@ class NodeManager:
         self.local_grants_total = 0
         self.local_spillbacks_total = 0
 
+        # Per-node observability agent (reference: dashboard/agent.py —
+        # the per-node DashboardAgent beside every raylet). Served over
+        # THIS server + the GCS conn; no separate process or port.
+        from ray_tpu.dashboard.agent import NodeAgent
+
+        self.agent = NodeAgent(
+            self, ring_size=int(config.flight_recorder_events))
+
         # Server for workers, remote pullers, and actor-task callers.
         self.server = protocol.Server(self._handle_server, name=f"nm-{node_name}")
         self.server.on_disconnect = self._on_server_disconnect
@@ -463,6 +471,9 @@ class NodeManager:
                 except protocol.ConnectionClosed:
                     pass
             self.oom_kills += 1
+            self.agent.record_event(
+                "oom_kill", worker_id=victim.worker_id.hex(),
+                pid=victim.proc.pid, detail=reason)
             try:
                 self.gcs.notify("task_events", [{
                     "task_id": tid.hex(),
@@ -518,6 +529,10 @@ class NodeManager:
                 cur_cpu = self._read_proc_stat()
                 hw = self._sample_hardware(prev_cpu, cur_cpu)
                 prev_cpu = cur_cpu
+                # Metric snapshots join the flight-recorder ring: a
+                # postmortem shows resource pressure alongside the task
+                # events that hit it.
+                self.agent.record_event("hw_sample", hw=hw)
                 with self._lock:
                     local_held = self._local_held.to_dict()
                     held_seq = self._local_held_seq
@@ -922,6 +937,26 @@ class NodeManager:
             w.pending_pushes = []
             actor_id = w.actor_id
             lease_reply, w.lease_reply = w.lease_reply, None
+        death_detail = w.death_reason or f"exit code {w.proc.poll()}"
+        self.agent.record_event(
+            "worker_death",
+            worker_id=w.worker_id.hex(),
+            actor_id=actor_id.hex() if actor_id else None,
+            pid=w.proc.pid, prev_state=prev_state,
+            killed_by_us=w.killed_by_us, detail=death_detail,
+            tasks=[tid.hex() for tid in tasks])
+        if not w.killed_by_us and not self._shutdown \
+                and (tasks or actor_id is not None
+                     or prev_state == LEASED):
+            # Unexpected death with work bound to the worker — in-flight
+            # tasks, an actor, or a checked-out lease (whose
+            # direct-transport tasks the NM cannot see; idle-pool
+            # retires exit clean and are not postmortem-worthy): leave
+            # the flight-recorder artifact now, while the ring still
+            # holds the victim's last task events/spans.
+            self.agent.recorder.dump(
+                f"worker {w.worker_id.hex()[:12]} died unexpectedly "
+                f"({death_detail})")
         self._release_local_grant(dead_lease_tag)
         if lease_reply is not None:
             # Died before registering: tell the waiting lease caller so it
@@ -1063,9 +1098,10 @@ class NodeManager:
             elif mtype == protocol.REVOKE_LOCAL_LEASE:
                 self._on_revoke_local_lease(payload)
             elif mtype == "dump_stacks":
-                # SIGUSR2 -> worker_main's faulthandler prints every
-                # thread's stack to stderr -> per-worker log file -> log
-                # stream (reference: `ray stack`).
+                # Legacy signal path: SIGUSR2 -> worker_main's
+                # faulthandler prints every thread's stack to stderr ->
+                # per-worker log file -> log stream (reference:
+                # `ray stack`). The in-band data path is collect_stacks.
                 with self._lock:
                     pids = [w.proc.pid for w in self._workers.values()
                             if w.proc.poll() is None]
@@ -1074,10 +1110,46 @@ class NodeManager:
                         os.kill(pid, signal.SIGUSR2)
                     except OSError:
                         pass
+            elif mtype in ("collect_stacks", "agent_logs",
+                           "flight_snapshot"):
+                self._handle_agent(conn, mtype, payload, msg_id)
+            elif mtype == "flight_dump":
+                # Fan-out notify (gang supervisor declared slice death):
+                # no reply expected.
+                self._handle_agent(conn, mtype, payload, msg_id,
+                                   reply=False)
             elif mtype == "shutdown":
                 threading.Thread(target=self.shutdown, daemon=True).start()
         except Exception:
             logger.exception("node manager: error handling %s", mtype)
+
+    def _handle_agent(self, conn, mtype, payload, msg_id,
+                      reply: bool = True):
+        """Dispatch an observability-agent message — always OFF this
+        conn's serve thread: collect_stacks waits on worker replies that
+        arrive via the NM's conns, agent_logs does per-worker file I/O,
+        and flight_dump writes to disk; none of it may stall delivery
+        of lease pushes / actor-state traffic on the same conn."""
+        def run():
+            try:
+                result = self.agent.handle(mtype, payload)
+            except Exception as e:
+                logger.exception("agent: error handling %s", mtype)
+                if reply:
+                    try:
+                        conn.reply_error(msg_id,
+                                         f"{type(e).__name__}: {e}")
+                    except protocol.ConnectionClosed:
+                        pass
+                return
+            if reply:
+                try:
+                    conn.reply(msg_id, result)
+                except protocol.ConnectionClosed:
+                    pass
+
+        threading.Thread(target=run, daemon=True,
+                         name="rtpu-nm-agent").start()
 
     def _on_store_error_objects(self, p):
         kind = p.get("kind", "task")
@@ -1650,6 +1722,17 @@ class NodeManager:
                 self._on_spill_now(conn, payload, msg_id)
             elif mtype == "store_stats":
                 conn.reply(msg_id, self.store.stats())
+            elif mtype == "task_events":
+                # Workers mirror their task-event/span batches here so
+                # the flight recorder holds this node's recent activity
+                # (the GCS copy feeds the timeline; this one feeds
+                # postmortems).
+                self.agent.record_task_events(payload or [])
+            elif mtype in ("collect_stacks", "agent_logs",
+                           "flight_snapshot", "flight_dump"):
+                # The agent endpoint is also directly addressable on the
+                # node (same transport the GCS fan-in uses).
+                self._handle_agent(conn, mtype, payload, msg_id)
             else:
                 conn.reply_error(msg_id, f"nm: unknown message {mtype}")
         except Exception as e:

@@ -290,6 +290,15 @@ class Conn:
                 self._pending.pop(fut.msg_id, None)
             raise
 
+    def abandon(self, fut: "_Future") -> None:
+        """Drop a request_nowait future's pending slot after handling a
+        timeout yourself — a late reply then resolves nothing, and the
+        slot doesn't leak for the life of the conn (the same hygiene
+        ``request`` applies internally)."""
+        if fut.msg_id is not None:
+            with self._pending_lock:
+                self._pending.pop(fut.msg_id, None)
+
     def reply(self, to_msg_id: int, payload: Any = None) -> None:
         self._send(self._alloc_id(), to_msg_id, "reply", payload)
 
@@ -363,6 +372,39 @@ class Conn:
     @property
     def closed(self) -> bool:
         return self._closed
+
+
+def fanout_requests(targets, mtype: str, payload: Any,
+                    timeout_s: float, floor_s: float = 0.1):
+    """Bounded parallel request fan-out: ``request_nowait`` to every
+    conn in ``targets`` ([(key, Conn), ...]), then collect under ONE
+    shared deadline, abandoning the pending slot of anything that timed
+    out (the per-request hygiene ``request`` applies internally).
+    Returns ``[(key, ok, reply_or_error_str), ...]`` in target order —
+    used by the GCS's per-node agent fan-in and the node agent's
+    per-worker stack capture."""
+    futs = []
+    for key, conn in targets:
+        try:
+            futs.append((key, conn, conn.request_nowait(mtype, payload)))
+        except Exception as e:
+            futs.append((key, conn, f"{type(e).__name__}: {e}"))
+    deadline = time.monotonic() + timeout_s
+    out = []
+    for key, conn, fut in futs:
+        if isinstance(fut, str):        # request_nowait itself failed
+            out.append((key, False, fut))
+            continue
+        try:
+            out.append((key, True, fut.result(
+                max(floor_s, deadline - time.monotonic()))))
+        except Exception as e:
+            out.append((key, False, f"{type(e).__name__}: {e}"))
+            try:
+                conn.abandon(fut)
+            except Exception:
+                pass
+    return out
 
 
 class _Future:

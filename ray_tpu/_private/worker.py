@@ -600,6 +600,21 @@ class CoreWorker:
         if self._closed:
             return
         self._closed = True
+        # Observability teardown first, while the GCS channel is still
+        # open: join the metrics reporter thread (repeated
+        # init()/shutdown() cycles must not stack reporters) and flush
+        # any buffered driverside trace spans.
+        from ray_tpu.util import metrics as metrics_mod
+        from ray_tpu.util import tracing as tracing_mod
+
+        try:
+            metrics_mod.stop_reporter()
+        except Exception:
+            pass
+        try:
+            tracing_mod.flush_spans()
+        except Exception:
+            pass
         if self._lease_mgr is not None:
             try:
                 self._lease_mgr.close()

@@ -108,6 +108,13 @@ class WorkerExecutor:
             "direct_address_ux": (self.direct_ux.address
                                   if self.direct_ux is not None else None)})
         self.node_id = reply["node_id"]
+        # Intra-task spans (serve hops, collectives, device transfers)
+        # route through this executor's task-event buffer: one flusher
+        # ships them to the GCS timeline AND the node agent's flight
+        # recorder alongside the task events they nest under.
+        from ray_tpu.util import tracing
+
+        tracing.set_sink(self._record_span_event)
 
     # ------------------------------------------------------------- plumbing
 
@@ -128,6 +135,14 @@ class WorkerExecutor:
                 refs.decref(d.binary())
 
     def _on_msg(self, conn, mtype, payload, msg_id):
+        if mtype == "dump_stacks":
+            # In-band stack capture (the data path behind `ray_tpu
+            # stack`): answered HERE, on the conn's listener thread —
+            # a main thread wedged inside a collective still reports
+            # exactly where it is. The SIGUSR2/faulthandler seam stays
+            # as the out-of-band fallback.
+            self._reply_stacks(conn, msg_id)
+            return
         if mtype == "run_actor_task":
             # Pin args the moment the spec lands here: the task may sit in
             # this actor's queue for a long time, and the caller's refs may
@@ -166,6 +181,24 @@ class WorkerExecutor:
             self._handle_cancel(payload["task_id"])
         elif mtype == "ping":
             conn.reply(msg_id, True)
+
+    def _reply_stacks(self, conn, msg_id):
+        from ray_tpu.dashboard.agent import current_stacks
+
+        try:
+            cur = self._current_task_id
+            actor_id = None
+            if self.actor_spec is not None:
+                actor_id = self.actor_spec.actor_id.binary().hex()
+            conn.reply(msg_id, {
+                "worker_id": self.worker_id.hex(),
+                "pid": os.getpid(),
+                "actor_id": actor_id,
+                "current_task_id": cur.hex() if cur else None,
+                "threads": current_stacks(),
+            })
+        except protocol.ConnectionClosed:
+            pass
 
     def _on_direct_disconnect(self, conn):
         # The lease holder hung up. Only tell the NM when NO direct conn
@@ -684,6 +717,15 @@ class WorkerExecutor:
                 "parent_span_id": trace.get("parent_span_id"),
             })
 
+    def _record_span_event(self, ev: dict):
+        """tracing sink: span events join the task-event batch with this
+        worker's identity attached."""
+        ev.setdefault("node_id", self.node_id)
+        ev.setdefault("worker_id", self.worker_id.hex())
+        ev.setdefault("pid", os.getpid())
+        with self._event_lock:
+            self._event_buf.append(ev)
+
     def _event_flush_loop(self):
         while not self._event_stop.wait(0.2):
             self._flush_events()
@@ -695,6 +737,13 @@ class WorkerExecutor:
             return
         try:
             self.core.gcs.notify("task_events", batch)
+        except Exception:
+            pass
+        # Mirror to the node agent's flight recorder: the postmortem of
+        # a slice death needs this node's last events locally, with no
+        # dependency on the GCS being reachable at dump time.
+        try:
+            self.nm.notify("task_events", batch)
         except Exception:
             pass
 

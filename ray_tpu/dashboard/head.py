@@ -118,6 +118,8 @@ class DashboardHead:
                            self._serve_applications_get)
         app.router.add_put("/api/serve/applications",
                            self._serve_applications_put)
+        app.router.add_get("/api/logs", self._logs)
+        app.router.add_get("/api/stacks", self._stacks)
         app.router.add_get("/api/{what}", self._api)
         app.router.add_get("/metrics", self._metrics)
         runner = web.AppRunner(app)
@@ -165,6 +167,54 @@ class DashboardHead:
         if data is None:
             return web.json_response({"error": f"unknown api {what}"},
                                      status=404)
+        return web.Response(text=json.dumps(data, default=repr),
+                            content_type="application/json")
+
+    async def _logs(self, request):
+        """Per-worker log tail with head fan-in (reference:
+        dashboard/modules/log REST over the per-node log agents).
+        Query: worker_id / actor_id / id (either-prefix) / stream /
+        lines / list=1 / node_id."""
+        from aiohttp import web
+        from ray_tpu._private import worker as worker_mod
+
+        q = request.query
+        payload = {k: q[k] for k in
+                   ("worker_id", "actor_id", "id", "stream", "node_id")
+                   if k in q}
+        if q.get("list"):
+            payload["list"] = True
+        if q.get("lines"):
+            payload["lines"] = int(q["lines"])
+        loop = asyncio.get_running_loop()
+
+        def fetch():
+            w = worker_mod.require_worker()
+            return w.gcs.request("agent_logs", payload, timeout=30)
+
+        data = await loop.run_in_executor(None, fetch)
+        return web.Response(text=json.dumps(data, default=repr),
+                            content_type="application/json")
+
+    async def _stacks(self, request):
+        """Cluster-wide in-band stack capture (the REST face of
+        `ray_tpu stack`)."""
+        from aiohttp import web
+        from ray_tpu._private import worker as worker_mod
+
+        q = request.query
+        payload = {}
+        if q.get("node_id"):
+            payload["node_id"] = q["node_id"]
+        if q.get("timeout_s"):
+            payload["timeout_s"] = float(q["timeout_s"])
+        loop = asyncio.get_running_loop()
+
+        def fetch():
+            w = worker_mod.require_worker()
+            return w.gcs.request("collect_stacks", payload, timeout=30)
+
+        data = await loop.run_in_executor(None, fetch)
         return web.Response(text=json.dumps(data, default=repr),
                             content_type="application/json")
 

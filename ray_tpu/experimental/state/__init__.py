@@ -2,9 +2,12 @@
 + ``dashboard/state_aggregator.py:134`` — ``ray list/get/summarize``)."""
 
 from ray_tpu.experimental.state.api import (  # noqa: F401
+    dump_stacks,
     get_actor,
+    get_log,
     list_actors,
     list_jobs,
+    list_logs,
     list_nodes,
     list_objects,
     list_placement_groups,
@@ -15,4 +18,5 @@ from ray_tpu.experimental.state.api import (  # noqa: F401
 __all__ = [
     "list_actors", "list_tasks", "list_nodes", "list_objects",
     "list_placement_groups", "list_jobs", "summarize_tasks", "get_actor",
+    "list_logs", "get_log", "dump_stacks",
 ]

@@ -56,3 +56,54 @@ def get_actor(actor_id_hex: str) -> Optional[Dict[str, Any]]:
         if a.get("actor_id") == actor_id_hex:
             return a
     return None
+
+
+# ------------------------------------------------- per-node agent views
+# (reference: experimental/state log/stack APIs backed by the per-node
+# dashboard agents; here the GCS fans in for us)
+
+
+def list_logs(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Per-node listing of workers with log files (alive and dead)."""
+    payload: Dict[str, Any] = {"list": True}
+    if node_id:
+        payload["node_id"] = node_id
+    return _gcs().request("agent_logs", payload, timeout=30)
+
+
+def get_log(worker_id: Optional[str] = None,
+            actor_id: Optional[str] = None,
+            ident: Optional[str] = None,
+            stream: Optional[str] = None,
+            lines: int = 100) -> List[Dict[str, Any]]:
+    """Tail matching workers' stdout/stderr cluster-wide. Ids match on
+    hex prefixes; ``ident`` matches worker OR actor id. Returns one
+    entry per (worker, stream) with the last ``lines`` lines."""
+    payload: Dict[str, Any] = {"lines": lines}
+    if worker_id:
+        payload["worker_id"] = worker_id
+    if actor_id:
+        payload["actor_id"] = actor_id
+    if ident:
+        payload["id"] = ident
+    if stream:
+        payload["stream"] = stream
+    out: List[Dict[str, Any]] = []
+    for node in _gcs().request("agent_logs", payload, timeout=30):
+        if isinstance(node, list):
+            out.extend(node)
+        elif isinstance(node, dict) and node.get("error"):
+            out.append(node)
+    return out
+
+
+def dump_stacks(node_id: Optional[str] = None,
+                timeout_s: float = 5.0) -> List[Dict[str, Any]]:
+    """In-band cluster-wide stack capture: every worker's
+    ``sys._current_frames()`` as data, one dict per node (the
+    programmatic face of ``ray_tpu stack``)."""
+    payload: Dict[str, Any] = {"timeout_s": timeout_s}
+    if node_id:
+        payload["node_id"] = node_id
+    return _gcs().request("collect_stacks", payload,
+                          timeout=timeout_s + 15)

@@ -536,21 +536,32 @@ class StoreGroup(BaseGroup):
                     reason=self._poisoned) from e
 
     def _exchange(self, tag: str, value) -> List[Any]:
+        from ray_tpu.util import tracing
+
         self._seq += 1
         key = f"{tag}:{self._seq}"
         deadline = time.time() + self._op_timeout_s
-        self._coord_call(
-            lambda: self._coord.contribute.remote(key, self.rank, value),
-            deadline, tag)
-        while True:
-            vals = self._coord_call(
-                lambda: self._coord.collect.remote(key, self.rank),
+        # One span per collective op (covering every _coord_call round
+        # trip inside it): `ray_tpu timeline` shows the rank's task span
+        # containing its collective waits, so a wedged op is visible as
+        # one long collective slice, not a mystery gap.
+        with tracing.span(f"collective.{key}", kind="collective",
+                          attrs={"group": self.group_name,
+                                 "rank": self.rank,
+                                 "world_size": self.world_size}):
+            self._coord_call(
+                lambda: self._coord.contribute.remote(key, self.rank,
+                                                      value),
                 deadline, tag)
-            if vals is not None:
-                return vals
-            if time.time() > deadline:
-                raise TimeoutError(f"collective op {tag} timed out")
-            time.sleep(0.002)
+            while True:
+                vals = self._coord_call(
+                    lambda: self._coord.collect.remote(key, self.rank),
+                    deadline, tag)
+                if vals is not None:
+                    return vals
+                if time.time() > deadline:
+                    raise TimeoutError(f"collective op {tag} timed out")
+                time.sleep(0.002)
 
     @staticmethod
     def _reduce(arrs: List[np.ndarray], op: ReduceOp) -> np.ndarray:
@@ -593,29 +604,40 @@ class StoreGroup(BaseGroup):
         self._exchange("barrier", None)
 
     def send(self, tensor, dst_rank: int):
+        from ray_tpu.util import tracing
+
         chan = (self.rank, dst_rank)
         seq = self._p2p_seq.get(chan, 0) + 1
         self._p2p_seq[chan] = seq
         key = f"p2p:{self.rank}->{dst_rank}:{seq}"
         payload = np.asarray(tensor)
-        self._coord_call(
-            lambda: self._coord.post.remote(key, payload),
-            time.time() + self._op_timeout_s, "send")
+        with tracing.span(f"collective.{key}", kind="collective",
+                          attrs={"group": self.group_name,
+                                 "rank": self.rank}):
+            self._coord_call(
+                lambda: self._coord.post.remote(key, payload),
+                time.time() + self._op_timeout_s, "send")
 
     def recv(self, shape, dtype, src_rank: int):
+        from ray_tpu.util import tracing
+
         chan = (src_rank, self.rank)
         seq = self._p2p_seq.get(chan, 0) + 1
         self._p2p_seq[chan] = seq
         key = f"p2p:{src_rank}->{self.rank}:{seq}"
         deadline = time.time() + self._op_timeout_s
-        while True:
-            val = self._coord_call(
-                lambda: self._coord.take.remote(key), deadline, "recv")
-            if val is not None:
-                return np.asarray(val, dtype=dtype).reshape(shape)
-            if time.time() > deadline:
-                raise TimeoutError("recv timed out")
-            time.sleep(0.002)
+        with tracing.span(f"collective.{key}", kind="collective",
+                          attrs={"group": self.group_name,
+                                 "rank": self.rank}):
+            while True:
+                val = self._coord_call(
+                    lambda: self._coord.take.remote(key), deadline,
+                    "recv")
+                if val is not None:
+                    return np.asarray(val, dtype=dtype).reshape(shape)
+                if time.time() > deadline:
+                    raise TimeoutError("recv timed out")
+                time.sleep(0.002)
 
     def destroy(self):
         import ray_tpu

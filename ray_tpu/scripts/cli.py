@@ -8,6 +8,8 @@ Usage: ``python -m ray_tpu <command>``
   list    {tasks|actors|nodes|objects|jobs|placement-groups}
   summary tasks
   timeline [--output FILE]
+  stack   [--node PREFIX] [--timeout S]   # in-band cluster-wide stacks
+  logs    [WORKER|ACTOR] [--lines N]      # per-worker log fan-in
 """
 
 from __future__ import annotations
@@ -197,26 +199,92 @@ def cmd_down(args) -> int:
     return 0
 
 
+def format_stack_report(nodes: list) -> str:
+    """Render the collect_stacks fan-in (list of per-node dicts) as the
+    text report ``ray_tpu stack`` prints."""
+    lines = []
+    for node in nodes:
+        nid = (node.get("node_id") or "?")[:12]
+        if node.get("error"):
+            lines.append(f"=== node {nid}: ERROR {node['error']}")
+            continue
+        lines.append(f"=== node {nid} "
+                     f"({len(node.get('workers') or [])} workers)")
+        for w in node.get("workers") or []:
+            who = f"worker {w.get('worker_id', '?')[:12]} " \
+                  f"pid={w.get('pid')}"
+            if w.get("actor_id"):
+                who += f" actor={w['actor_id'][:12]}"
+            if w.get("current_task_id"):
+                who += f" task={w['current_task_id'][:12]}"
+            if w.get("error"):
+                lines.append(f"--- {who}: ERROR {w['error']}")
+                continue
+            lines.append(f"--- {who}")
+            for t in w.get("threads") or []:
+                lines.append(f"  thread {t.get('thread_name') or ''} "
+                             f"({t.get('thread_id')}):")
+                for ln in (t.get("stack") or "").splitlines():
+                    lines.append(f"    {ln}")
+    return "\n".join(lines)
+
+
 def cmd_stack(args) -> int:
-    """Dump every worker's Python stacks cluster-wide (reference:
-    ``ray stack``) — dumps arrive through the worker log stream."""
-    import ray_tpu
+    """Snapshot every worker's Python stacks cluster-wide, in-band
+    (reference: ``ray stack``): the per-node agents fan a dump_stacks
+    RPC to each worker's socket listener thread and the frames come
+    back as data — a rank wedged inside a collective is diagnosable in
+    one bounded command, no SIGUSR2, no log scraping."""
+    ray_tpu = _connect(args.address)
     from ray_tpu._private import worker as worker_mod
 
-    from ray_tpu._private.config import config
-
-    addr = args.address or config.refresh_from_env("address")
-    if not addr and os.path.exists(_ADDR_FILE):
-        addr = open(_ADDR_FILE).read().strip()
-    if not addr:
-        print("no cluster address: pass --address or set RAY_TPU_ADDRESS",
-              file=sys.stderr)
-        return 1
-    ray_tpu.init(address=addr, log_to_driver=True)
+    payload = {"timeout_s": args.timeout}
+    if args.node:
+        payload["node_id"] = args.node
     try:
-        n = worker_mod.require_worker().gcs.request("dump_stacks", {})
-        print(f"requested stack dumps from {n} node(s); collecting...")
-        time.sleep(3.0)  # dumps stream in via driver_logs
+        nodes = worker_mod.require_worker().gcs.request(
+            "collect_stacks", payload, timeout=args.timeout + 15)
+        print(format_stack_report(nodes))
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """Tail a worker's (or actor's) stdout/stderr cluster-wide
+    (reference: ``ray logs``): the head fans the request to the
+    per-node agents, which read the session log files — including for
+    workers that already died."""
+    ray_tpu = _connect(args.address)
+    from ray_tpu._private import worker as worker_mod
+
+    payload: dict = {"lines": args.lines}
+    if args.target:
+        payload["id"] = args.target
+    if args.stream:
+        payload["stream"] = args.stream
+    try:
+        nodes = worker_mod.require_worker().gcs.request(
+            "agent_logs", payload, timeout=30)
+        shown = 0
+        for node in nodes:
+            if isinstance(node, dict) and node.get("error"):
+                print(f"=== node {node.get('node_id', '?')[:12]}: "
+                      f"ERROR {node['error']}", file=sys.stderr)
+                continue
+            for entry in node if isinstance(node, list) else []:
+                head = (f"=== {entry['stream']} of worker "
+                        f"{entry['worker_id'][:12]}")
+                if entry.get("actor_id"):
+                    head += f" (actor {entry['actor_id'][:12]})"
+                head += f" on node {entry['node_id'][:12]}"
+                print(head)
+                for ln in entry["lines"]:
+                    print(ln)
+                shown += 1
+        if not shown:
+            print("no matching worker logs", file=sys.stderr)
+            return 1
     finally:
         ray_tpu.shutdown()
     return 0
@@ -282,20 +350,49 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def build_chrome_trace(events: list) -> list:
+    """Chrome-trace records from task events/spans: one complete ("X")
+    slice per event, plus flow-event pairs ("s"/"f") binding each child
+    span to its parent — chrome://tracing / Perfetto then draw the
+    submit → lease → run → collective → KV-handoff chain as one
+    connected trace instead of unrelated slices."""
+    by_span = {ev["span_id"]: ev for ev in events if ev.get("span_id")}
+
+    def _loc(ev):
+        return {"pid": (ev.get("node_id") or "")[:8],
+                "tid": ev.get("pid", 0)}
+
+    trace = []
+    for ev in events:
+        trace.append({
+            "name": ev["name"], "cat": ev.get("kind", "task"), "ph": "X",
+            "ts": ev["start"] * 1e6,
+            "dur": (ev["end"] - ev["start"]) * 1e6,
+            **_loc(ev),
+            "args": {"status": ev.get("status"),
+                     "trace_id": ev.get("trace_id"),
+                     "span_id": ev.get("span_id"),
+                     "parent_span_id": ev.get("parent_span_id")},
+        })
+        parent = by_span.get(ev.get("parent_span_id"))
+        if parent is None or not ev.get("span_id"):
+            continue
+        flow = {"name": "trace", "cat": ev.get("trace_id") or "trace",
+                "id": ev["span_id"]}
+        # Flow start binds inside the parent slice; flow finish binds
+        # at the child slice's start (bp=e: enclosing-slice binding).
+        trace.append({**flow, "ph": "s", **_loc(parent),
+                      "ts": parent["start"] * 1e6})
+        trace.append({**flow, "ph": "f", "bp": "e", **_loc(ev),
+                      "ts": ev["start"] * 1e6})
+    return trace
+
+
 def cmd_timeline(args) -> int:
     """Chrome-trace export (reference: ``ray timeline`` — chrome://tracing
     format from GCS task events)."""
     ray_tpu = _connect(args.address)
-    events = ray_tpu.timeline()
-    trace = [{
-        "name": ev["name"], "cat": ev.get("kind", "task"), "ph": "X",
-        "ts": ev["start"] * 1e6, "dur": (ev["end"] - ev["start"]) * 1e6,
-        "pid": ev.get("node_id", "")[:8], "tid": ev.get("pid", 0),
-        "args": {"status": ev.get("status"),
-                 "trace_id": ev.get("trace_id"),
-                 "span_id": ev.get("span_id"),
-                 "parent_span_id": ev.get("parent_span_id")},
-    } for ev in events]
+    trace = build_chrome_trace(ray_tpu.timeline())
     out = args.output or "timeline.json"
     with open(out, "w") as f:
         json.dump(trace, f)
@@ -328,7 +425,19 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("stack")
     p.add_argument("--address", default=None)
+    # All nodes is the default scope; --node narrows it.
+    p.add_argument("--node", default=None,
+                   help="restrict to one node id (hex prefix)")
+    p.add_argument("--timeout", type=float, default=5.0)
     p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("logs")
+    p.add_argument("target", nargs="?", default=None,
+                   help="worker or actor id (hex prefix); omit for all")
+    p.add_argument("--lines", type=int, default=100)
+    p.add_argument("--stream", choices=["stdout", "stderr"], default=None)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("status")
     p.add_argument("--address", default=None)

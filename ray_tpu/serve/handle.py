@@ -315,11 +315,23 @@ class DeploymentHandle:
             return a if self._load_of(a) <= self._load_of(b) else b
 
     def _submit(self, method: str, args, kwargs, fresh: bool = False):
+        from ray_tpu.util import tracing
+
         if fresh:
             self._refresh(force=True)
         replica = self._pick()
         done = self._note_submit(replica)
-        return replica.handle_request.remote(method, args, kwargs), done
+        # The handle hop is a span: the replica's handle_request task
+        # submits inside it, so its task event parents under this hop
+        # and `ray_tpu timeline` shows caller -> handle -> replica ->
+        # (engine / KV transfer) as one connected trace.
+        with tracing.span(
+                f"serve.handle.{self.deployment_name}.{method}",
+                kind="serve_handle",
+                attrs={"deployment": self.deployment_name,
+                       "method": method}):
+            ref = replica.handle_request.remote(method, args, kwargs)
+        return ref, done
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         ref, done = self._submit(self._method, args, kwargs)
@@ -335,14 +347,19 @@ class DeploymentHandle:
     def _submit_stream(self, method: str, args,
                        kwargs) -> DeploymentResponseGenerator:
         import ray_tpu
+        from ray_tpu.util import tracing
 
         replica = self._pick()
         done = self._note_submit(replica)
         try:
-            sid = ray_tpu.get(
-                replica.handle_request_stream.remote(method, args,
-                                                     kwargs),
-                timeout=_STREAM_START_TIMEOUT_S)
+            with tracing.span(
+                    f"serve.handle.{self.deployment_name}.{method}",
+                    kind="serve_handle",
+                    attrs={"deployment": self.deployment_name,
+                           "method": method, "streaming": True}):
+                start_ref = replica.handle_request_stream.remote(
+                    method, args, kwargs)
+            sid = ray_tpu.get(start_ref, timeout=_STREAM_START_TIMEOUT_S)
         except BaseException:
             done()
             raise

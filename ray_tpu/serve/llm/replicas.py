@@ -30,20 +30,14 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from ray_tpu.serve.llm.engine import EngineConfig, InflightBatchEngine
 
-_reporter_lock = threading.Lock()
-_reporter_started = False
-
 
 def _ensure_metrics_reporter() -> None:
-    """One metrics-push thread per replica process (idempotent)."""
-    global _reporter_started
-    with _reporter_lock:
-        if _reporter_started:
-            return
-        from ray_tpu.util import metrics
+    """One metrics-push thread per replica process. start_reporter is
+    idempotent-per-process (and joined on shutdown), so this is just a
+    period request: replica gauges want the tighter 2 s push."""
+    from ray_tpu.util import metrics
 
-        metrics.start_reporter(period_s=2.0)
-        _reporter_started = True
+    metrics.start_reporter(period_s=2.0)
 
 
 def normalize_request(request: Any) -> Dict[str, Any]:

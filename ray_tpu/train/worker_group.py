@@ -294,6 +294,21 @@ class WorkerGroup:
         except Exception:
             pass
         collective.poison_group(self.group_name, reason)
+        # Slice death declared: every node dumps its flight-recorder
+        # ring, so the restart leaves postmortem artifacts holding the
+        # dead rank's last task events/spans and the node's resource
+        # samples (see dashboard/agent.py FlightRecorder).
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker()
+            if w is not None:
+                w.gcs.notify("flight_dump", {
+                    "reason": f"gang {self.group_name} poisoned: "
+                              f"{reason}"})
+        except Exception:
+            logger.warning("flight-recorder dump request failed after "
+                           "gang poison", exc_info=True)
 
     def _supervise_loop(self):
         """Watch the gang for member death: GCS actor-failure notifications

@@ -11,9 +11,12 @@ format.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("ray_tpu.metrics")
 
 _registry_lock = threading.Lock()
 _registry: Dict[str, "_Metric"] = {}
@@ -200,8 +203,18 @@ def prometheus_text(sample_groups: List[List[dict]]) -> str:
     return "\n".join(lines) + "\n"
 
 
+# One warning per failure KIND (exception type): metric reporting is
+# best-effort by contract, but a silently-failing reporter left stale
+# gauges on /metrics for whole incidents before anyone noticed — say it
+# once, without turning a flaky GCS into a log flood.
+_report_failures_logged: set = set()
+
+
 def report_to_gcs() -> bool:
-    """Push this process's samples to the GCS metrics table."""
+    """Push this process's samples to the GCS metrics table. The payload
+    carries the reporting period so the GCS can expire this client's
+    series once it misses ~3 periods (downscaled replicas must not
+    report stale gauges forever)."""
     from ray_tpu._private import worker as worker_mod
 
     w = worker_mod.global_worker()
@@ -212,21 +225,67 @@ def report_to_gcs() -> bool:
             "client_id": w.client_id,
             "samples": collect_samples(),
             "ts": time.time(),
+            "period_s": _reporter_period_s(),
         })
         return True
-    except Exception:
+    except Exception as e:
+        kind = type(e).__name__
+        if kind not in _report_failures_logged:
+            _report_failures_logged.add(kind)
+            logger.warning(
+                "metrics report to the GCS failed (%s: %s); further "
+                "failures of this kind are not logged", kind, e)
         return False
 
 
+# Reporter lifecycle: ONE daemon thread per process, stoppable. Every
+# subsystem that wants its metrics shipped (lease manager, gang
+# supervisor, serve replicas) calls start_reporter(); only the first
+# call spawns the thread, and shutdown() joins it — repeated
+# init()/shutdown() cycles must not stack reporter threads.
+_reporter_lock = threading.Lock()
+_reporter_thread: Optional[threading.Thread] = None
+_reporter_stop: Optional[threading.Event] = None
+_reporter_period = 5.0
+
+
+def _reporter_period_s() -> float:
+    with _reporter_lock:
+        return _reporter_period
+
+
 def start_reporter(period_s: float = 5.0) -> threading.Thread:
-    """Background reporter thread (the per-process analog of the
-    reference's per-node metrics agent push loop)."""
+    """Start (or return) this process's metrics push loop (the
+    per-process analog of the reference's per-node metrics agent push
+    loop). Idempotent: the first caller's thread serves everyone; a
+    caller asking for a faster period tightens the running loop's."""
+    global _reporter_thread, _reporter_stop, _reporter_period
+    with _reporter_lock:
+        if _reporter_thread is not None and _reporter_thread.is_alive():
+            _reporter_period = min(_reporter_period, period_s)
+            return _reporter_thread
+        _reporter_period = period_s
+        stop = threading.Event()
+        _reporter_stop = stop
 
-    def loop():
-        while True:
-            time.sleep(period_s)
-            report_to_gcs()
+        def loop():
+            while not stop.wait(_reporter_period_s()):
+                report_to_gcs()
 
-    t = threading.Thread(target=loop, daemon=True, name="rtpu-metrics")
-    t.start()
-    return t
+        t = threading.Thread(target=loop, daemon=True, name="rtpu-metrics")
+        _reporter_thread = t
+        t.start()
+        return t
+
+
+def stop_reporter(timeout: float = 2.0) -> None:
+    """Stop and join the reporter thread (called from
+    ``ray_tpu.shutdown()``)."""
+    global _reporter_thread, _reporter_stop
+    with _reporter_lock:
+        t, _reporter_thread = _reporter_thread, None
+        stop, _reporter_stop = _reporter_stop, None
+    if stop is not None:
+        stop.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=timeout)

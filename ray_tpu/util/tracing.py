@@ -12,12 +12,24 @@ trace with zero extra RPCs. Always on (two small fields per spec).
 
 A span is identified by the task id; a trace groups every task
 transitively submitted from one root submission.
+
+Beyond task-boundary spans (which the task-event machinery emits for
+free), ``span()``/``emit_span()`` let ANY layer add intra-task spans to
+the same stream: serve handle hops, collective operations, device-object
+put/get transfers. They ride the identical event schema, so
+``ray_tpu timeline`` renders one connected cross-layer trace
+(submit -> lease -> run -> collective -> KV handoff) with zero new RPCs
+— span events batch into the existing ``task_events`` notify.
 """
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
-from typing import Any, Dict, Optional
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
 
 _current: contextvars.ContextVar[Optional[Dict[str, str]]] = \
     contextvars.ContextVar("rtpu_trace_ctx", default=None)
@@ -63,3 +75,139 @@ def activate(trace_ctx: Optional[Dict[str, Any]],
 
 def deactivate(token: contextvars.Token) -> None:
     _current.reset(token)
+
+
+# ------------------------------------------------------------------- spans
+#
+# Span events share the task-event schema (the GCS appends them to the
+# same ring the timeline reads). Worker processes register a sink that
+# routes spans through the executor's existing event buffer — one
+# flusher, one notify batch, and the node agent's flight recorder sees
+# them too. Driverside (no executor) spans buffer here and flush
+# opportunistically over the GCS channel.
+
+_sink: Optional[Callable[[dict], None]] = None
+_buf_lock = threading.Lock()
+_buf: deque = deque(maxlen=4096)   # bounded: un-flushable spans drop oldest
+_last_flush = 0.0
+_FLUSH_BATCH = 16
+_FLUSH_INTERVAL_S = 0.25
+
+
+def set_sink(sink: Optional[Callable[[dict], None]]) -> None:
+    """Route span events through ``sink`` instead of the local buffer
+    (worker_main points this at the executor's task-event buffer)."""
+    global _sink
+    _sink = sink
+
+
+def new_span_id() -> str:
+    return _new_trace_id()
+
+
+_UNSET = object()
+
+
+def emit_span(name: str, kind: str, start: float,
+              end: Optional[float] = None, status: str = "ok",
+              attrs: Optional[Dict[str, Any]] = None,
+              span_id: Optional[str] = None,
+              trace_id: Optional[str] = None,
+              parent_span_id: Any = _UNSET) -> None:
+    """Append one completed span to the task-event stream. By default
+    the span is a child of the active context (task span or enclosing
+    ``span()``); with no active context it roots a fresh trace. Explicit
+    trace_id/parent_span_id override the context (``span()`` passes its
+    own identity — by emit time its contextvar is already reset). Never
+    raises — tracing must not break the operation it observes."""
+    try:
+        ctx = _current.get()
+        sid = span_id or new_span_id()
+        ev = {
+            "task_id": sid,
+            "name": name,
+            "kind": kind,
+            "start": start,
+            "end": end if end is not None else time.time(),
+            "status": status,
+            "trace_id": trace_id or (
+                ctx["trace_id"] if ctx else _new_trace_id()),
+            "span_id": sid,
+            "parent_span_id": parent_span_id
+            if parent_span_id is not _UNSET
+            else (ctx["span_id"] if ctx else None),
+        }
+        if attrs:
+            ev["attrs"] = dict(attrs)
+        sink = _sink
+        if sink is not None:
+            sink(ev)
+            return
+        with _buf_lock:
+            _buf.append(ev)
+        _maybe_flush()
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "span",
+         attrs: Optional[Dict[str, Any]] = None):
+    """Context manager: everything submitted/emitted inside becomes a
+    child of this span (task submissions pick it up via ``for_submit``),
+    and the span itself lands in the task-event stream on exit."""
+    ctx = _current.get()
+    sid = new_span_id()
+    tid = ctx["trace_id"] if ctx else _new_trace_id()
+    parent = ctx["span_id"] if ctx else None
+    token = _current.set({
+        "trace_id": tid,
+        "span_id": sid,
+        "parent_span_id": parent,
+    })
+    start = time.time()
+    status = "ok"
+    try:
+        yield sid
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        _current.reset(token)
+        emit_span(name, kind, start, status=status, attrs=attrs,
+                  span_id=sid, trace_id=tid, parent_span_id=parent)
+
+
+def _maybe_flush() -> None:
+    global _last_flush
+    now = time.time()
+    with _buf_lock:
+        due = len(_buf) >= _FLUSH_BATCH or \
+            (now - _last_flush) >= _FLUSH_INTERVAL_S
+        if not due or not _buf:
+            return
+        _last_flush = now
+    flush_spans()
+
+
+def flush_spans() -> None:
+    """Ship buffered driverside spans to the GCS (called opportunistically
+    from emit_span and once on shutdown). Best-effort: no cluster, no
+    flush — the bounded buffer just keeps the most recent spans."""
+    from ray_tpu._private import worker as worker_mod
+
+    with _buf_lock:
+        if not _buf:
+            return
+        batch = list(_buf)
+        _buf.clear()
+    w = worker_mod.global_worker()
+    if w is None:
+        # Put them back (bounded deque: overflow drops oldest).
+        with _buf_lock:
+            _buf.extendleft(reversed(batch))
+        return
+    try:
+        w.gcs.notify("task_events", batch)
+    except Exception:
+        pass

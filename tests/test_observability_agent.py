@@ -1,0 +1,398 @@
+"""Per-node observability agent: cluster-wide log/stack fan-in, the
+flight recorder, and reporter/metrics lifecycle (reference:
+dashboard/agent.py + reporter/log modules beside every raylet).
+
+The two load-bearing scenarios (ISSUE 8 acceptance):
+- a blocked collective rank's Python stack is retrievable cluster-wide
+  through the in-band `ray_tpu stack` path — bounded, no SIGUSR2;
+- a gang death leaves a flight-recorder dump on disk containing the
+  dead rank's last task events/spans.
+"""
+
+import json
+import glob
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import state
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import config
+
+
+@pytest.fixture
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _session_dir() -> str:
+    return worker_mod._global_cluster.session_dir
+
+
+def _flight_dir() -> str:
+    return os.path.join(_session_dir(), "flight_recorder")
+
+
+def _wait_for(cond, timeout, msg):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------------- stacks
+
+
+def test_wedged_collective_rank_stack_capture(ray_cluster):
+    """ISSUE 8 wedge test: one collective rank blocked in an allreduce
+    (its peer never joins the op) is diagnosable cluster-wide via the
+    in-band stack path — bounded, no SIGUSR2, no log scraping."""
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def join(self, world):
+            from ray_tpu.parallel import collective
+
+            collective.init_collective_group(
+                world, self.rank, backend="store", group_name="wedge_g")
+            return True
+
+        def reduce(self):
+            import numpy as np
+
+            from ray_tpu.parallel import collective
+
+            return collective.allreduce(
+                np.ones(4), group_name="wedge_g").tolist()
+
+    r0, r1 = Rank.remote(0), Rank.remote(1)
+    assert ray_tpu.get([r0.join.remote(2), r1.join.remote(2)],
+                       timeout=60) == [True, True]
+    wedged_ref = r0.reduce.remote()   # rank 1 never calls reduce
+    time.sleep(1.5)                   # let rank 0 enter the op
+
+    t0 = time.time()
+    nodes = state.dump_stacks(timeout_s=5)
+    assert time.time() - t0 < 20      # bounded capture
+    assert nodes and nodes[0].get("node_id")
+    # The wedged rank's main thread shows the collective frames.
+    wedged = [w for n in nodes for w in n.get("workers", [])
+              if any("_exchange" in t["stack"] or "allreduce" in t["stack"]
+                     for t in w.get("threads", []))]
+    assert wedged, json.dumps(nodes)[:2000]
+    assert wedged[0]["actor_id"] == r0._actor_id.hex()
+    # The CLI renderer shows the same frames as text.
+    from ray_tpu.scripts.cli import format_stack_report
+
+    report = format_stack_report(nodes)
+    assert "_exchange" in report or "allreduce" in report
+    assert "=== node" in report and "--- worker" in report
+
+    # Unwedge and clean up: poison raises GangMemberDiedError promptly.
+    from ray_tpu import exceptions
+    from ray_tpu.parallel import collective
+
+    collective.poison_group("wedge_g", "test teardown")
+    with pytest.raises((exceptions.GangMemberDiedError,
+                        exceptions.RayTaskError, Exception)):
+        ray_tpu.get(wedged_ref, timeout=30)
+
+
+def test_stack_capture_includes_node_manager_threads(ray_cluster):
+    nodes = state.dump_stacks(timeout_s=5)
+    nm = nodes[0]["node_manager"]
+    assert nm["pid"] == os.getpid()   # head NM is in-process here
+    names = {t["thread_name"] for t in nm["threads"]}
+    assert any(n.startswith("rtpu-nm-") for n in names), names
+
+
+# --------------------------------------------------------------- logs
+
+
+def test_worker_log_fan_in(ray_cluster):
+    @ray_tpu.remote
+    def chatty():
+        print("OBS_MARKER_fan_in")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=30) == 1
+
+    def marker_seen():
+        entries = state.get_log(lines=200)
+        return any("OBS_MARKER_fan_in" in ln
+                   for e in entries for ln in e.get("lines", []))
+
+    _wait_for(marker_seen, 15, "log marker through the agent fan-in")
+
+    # Listing mode enumerates the node's workers with their streams.
+    listing = state.list_logs()
+    assert listing and listing[0]["workers"]
+    assert all({"worker_id", "alive", "streams"} <= set(w)
+               for w in listing[0]["workers"])
+
+    # Prefix filtering by worker id narrows to that worker only.
+    entries = state.get_log(lines=200)
+    target = next(e for e in entries
+                  if any("OBS_MARKER_fan_in" in ln for ln in e["lines"]))
+    only = state.get_log(ident=target["worker_id"][:12], lines=200)
+    assert only and all(e["worker_id"] == target["worker_id"]
+                        for e in only)
+
+
+def test_actor_log_fan_in_routes_by_actor_id(ray_cluster):
+    @ray_tpu.remote
+    class Talker:
+        def say(self):
+            print("OBS_MARKER_actor_log")
+            return True
+
+    a = Talker.remote()
+    assert ray_tpu.get(a.say.remote(), timeout=30)
+    aid = a._actor_id.hex()
+
+    def seen():
+        entries = state.get_log(actor_id=aid, lines=200)
+        return any("OBS_MARKER_actor_log" in ln
+                   for e in entries for ln in e.get("lines", []))
+
+    _wait_for(seen, 15, "actor log lines through the agent")
+    entries = state.get_log(actor_id=aid, lines=200)
+    assert all(e["actor_id"] == aid for e in entries)
+
+
+def test_dead_workers_logs_reachable_by_actor_and_full_id(ray_cluster):
+    """Postmortem lookup: after an actor's worker dies, its log files
+    must stay reachable by actor id and FULL worker id (the agent keeps
+    an identity index outliving the NM's worker table)."""
+    @ray_tpu.remote
+    class Doomed:
+        def say(self):
+            print("OBS_MARKER_dead_actor")
+            return True
+
+    a = Doomed.remote()
+    assert ray_tpu.get(a.say.remote(), timeout=30)
+    aid = a._actor_id.hex()
+
+    def entries_for(**kw):
+        return [e for e in state.get_log(lines=200, **kw)
+                if any("OBS_MARKER_dead_actor" in ln
+                       for ln in e.get("lines", []))]
+
+    _wait_for(lambda: entries_for(actor_id=aid), 15,
+              "actor logs before death")
+    wid_full = entries_for(actor_id=aid)[0]["worker_id"]
+    assert len(wid_full) > 12
+
+    ray_tpu.kill(a)
+    # Once the worker leaves the NM table the row is rebuilt from the
+    # on-disk filename + identity index; both query shapes must hold.
+    _wait_for(lambda: any(not e.get("alive", True)
+                          for e in state.get_log(actor_id=aid,
+                                                 lines=1) or [{}])
+              or entries_for(actor_id=aid), 15, "post-death rows")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        by_actor = entries_for(actor_id=aid)
+        by_full_wid = entries_for(worker_id=wid_full)
+        if by_actor and by_full_wid:
+            break
+        time.sleep(0.3)
+    assert by_actor, "dead actor's logs unreachable by actor id"
+    assert by_full_wid, "dead worker's logs unreachable by full id"
+    assert by_actor[0]["actor_id"] == aid
+
+
+# ----------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_dump_on_gang_death():
+    """ISSUE 8 acceptance: a gang death leaves a flight-recorder dump on
+    disk containing the dead rank's last task events."""
+    old = config.get("gang_heartbeat_s")
+    config.set("gang_heartbeat_s", 0.5)
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    group = None
+    try:
+        group = WorkerGroup(2, {"CPU": 1}, backend="store",
+                            group_name="frgang", experiment_name="fr")
+        dead_actor = group.workers[1]._actor_id.hex()
+        # Give the workers' 0.2 s event flush a beat so the recorder
+        # holds their setup_collective task events before the kill.
+        time.sleep(1.0)
+        ray_tpu.kill(group.workers[1])
+
+        pattern = os.path.join(_flight_dir(), "flight-*.json")
+        _wait_for(lambda: glob.glob(pattern), 20,
+                  "a flight-recorder dump after gang death")
+        # Newest dump (worker-death and supervisor triggers may both
+        # fire; the supervisor's carries the gang reason).
+        dumps = [json.load(open(p)) for p in sorted(glob.glob(pattern))]
+        assert any("frgang" in (d.get("reason") or "")
+                   or "rank 1" in (d.get("reason") or "")
+                   or "died" in (d.get("reason") or "") for d in dumps)
+        events = [e for d in dumps for e in d["events"]]
+        # The dead rank's last task events made it into the artifact...
+        assert any(e.get("name") == "setup_collective" for e in events)
+        # ...and its worker's death is recorded against its actor id.
+        assert any(e.get("kind") == "worker_death"
+                   and e.get("actor_id") == dead_actor for e in events)
+        # Metric snapshots ride the same ring.
+        assert any(e.get("kind") == "hw_sample" for e in events)
+    finally:
+        if group is not None:
+            group.shutdown(graceful=False)
+        ray_tpu.shutdown()
+        config.set("gang_heartbeat_s", old)
+
+
+def test_flight_recorder_dump_on_unexpected_worker_death(ray_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def suicide():
+        import os as _os
+        import signal as _signal
+
+        _os.kill(_os.getpid(), _signal.SIGKILL)
+
+    with pytest.raises(Exception):
+        ray_tpu.get(suicide.remote(), timeout=30)
+    pattern = os.path.join(_flight_dir(), "flight-*.json")
+    _wait_for(lambda: glob.glob(pattern), 15,
+              "a dump after an unexpected worker death")
+    dump = json.load(open(sorted(glob.glob(pattern))[-1]))
+    assert "died unexpectedly" in dump["reason"]
+    assert any(e.get("kind") == "worker_death" for e in dump["events"])
+
+
+def test_flight_snapshot_over_node_agent_endpoint(ray_cluster):
+    """The agent endpoint is directly addressable on the node's
+    existing transport (no new server stack)."""
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(3)], timeout=30)
+    w = worker_mod.require_worker()
+    addr = w.nodes()[0]["NodeManagerAddress"]
+
+    def kinds():
+        snap = w.nm_conn(addr).request("flight_snapshot", {},
+                                       timeout=10)
+        return {e.get("kind") for e in snap["events"]}
+
+    # Worker event flush is 0.2 s; the hw sample rides the next 1 s
+    # heartbeat tick — poll rather than guess the interleaving.
+    _wait_for(lambda: {"task", "hw_sample"} <= kinds(), 15,
+              "task events + hw samples in the flight ring")
+
+
+# ------------------------------------------- reporter/metrics lifecycle
+
+
+def _reporter_threads():
+    return [t for t in threading.enumerate() if t.name == "rtpu-metrics"]
+
+
+def test_metrics_reporter_idempotent_and_joined_on_shutdown():
+    """ISSUE 8 satellite + acceptance: repeated start_reporter calls
+    share one thread, and ray_tpu.shutdown() joins it — init/shutdown
+    cycles must not stack reporter threads."""
+    from ray_tpu.util import metrics
+
+    for _ in range(2):
+        ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+        try:
+            t1 = metrics.start_reporter(period_s=0.2)
+            t2 = metrics.start_reporter(period_s=5.0)
+            t3 = metrics.start_reporter()
+            assert t1 is t2 is t3
+            assert len(_reporter_threads()) == 1
+        finally:
+            ray_tpu.shutdown()
+        _wait_for(lambda: not _reporter_threads(), 5,
+                  "reporter thread to be joined on shutdown")
+    assert not _reporter_threads()
+
+
+def test_metrics_drop_dead_client_series(ray_cluster):
+    """A downscaled/killed replica's gauges must leave /metrics within
+    3 reporting periods (or immediately once the GCS knows the client
+    is gone)."""
+    from ray_tpu.util import metrics
+
+    w = worker_mod.require_worker()
+    # A series from a client the GCS has no connection for (a killed
+    # replica): dropped on the next read.
+    w.gcs.notify("report_metrics", {
+        "client_id": "worker-deadbeef", "ts": time.time(),
+        "period_s": 2.0,
+        "samples": [{"name": "serve_llm_queue_depth",
+                     "tags": {"replica": "deadbeef"}, "value": 9.0,
+                     "kind": "gauge", "help": "stale"}]})
+    # The live driver's series stays.
+    g = metrics.Gauge("obs_live_gauge", "x")
+    g.set(1.0)
+    assert metrics.report_to_gcs()
+
+    def flat():
+        return [s for grp in w.gcs.request("get_metrics") for s in grp]
+
+    _wait_for(lambda: any(s["name"] == "obs_live_gauge"
+                          for s in flat()), 10, "live gauge visible")
+    assert not any(s["name"] == "serve_llm_queue_depth"
+                   and s["tags"].get("replica") == "deadbeef"
+                   for s in flat())
+
+    # Time-based expiry: a connected-but-silent client's series drop
+    # after missing ≥3 of its own reporting periods.
+    w.gcs.notify("report_metrics", {
+        "client_id": w.client_id + ":probe", "ts": time.time(),
+        "period_s": 0.1,
+        "samples": [{"name": "obs_silent_gauge", "tags": {},
+                     "value": 2.0, "kind": "gauge", "help": ""}]})
+    # (unknown client id: dropped for both reasons — assert it goes)
+    _wait_for(lambda: not any(s["name"] == "obs_silent_gauge"
+                              for s in flat()), 10,
+              "silent client's series to expire")
+
+
+def test_report_to_gcs_logs_failures_once_per_kind(caplog):
+    """The reporter must not swallow failures silently (raylint
+    exception-swallow triage): one warning per failure kind."""
+    import logging
+
+    from ray_tpu.util import metrics
+
+    class _BoomGcs:
+        def notify(self, *a, **k):
+            raise ConnectionResetError("boom")
+
+    class _FakeWorker:
+        gcs = _BoomGcs()
+        client_id = "fake"
+
+    old_worker = worker_mod._global_worker
+    metrics._report_failures_logged.clear()
+    worker_mod._global_worker = _FakeWorker()
+    try:
+        with caplog.at_level(logging.WARNING, logger="ray_tpu.metrics"):
+            assert metrics.report_to_gcs() is False
+            assert metrics.report_to_gcs() is False
+    finally:
+        worker_mod._global_worker = old_worker
+    warnings = [r for r in caplog.records
+                if "metrics report" in r.getMessage()]
+    assert len(warnings) == 1          # once per failure kind
+    assert "ConnectionResetError" in warnings[0].getMessage()

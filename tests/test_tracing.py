@@ -79,3 +79,175 @@ def test_separate_roots_get_separate_traces(ray_cluster):
     ray_tpu.get([t_a.remote(), t_b.remote()], timeout=60)
     evs = _events_by_name(["t_a", "t_b"])
     assert evs["t_a"]["trace_id"] != evs["t_b"]["trace_id"]
+
+
+def test_two_hop_chain_renders_connected_chrome_trace(ray_cluster):
+    """ISSUE 8 satellite: the chrome-trace export carries parent/child
+    relationships (flow events + span args), so a two-hop task chain
+    renders as one connected trace instead of flat slices."""
+    from ray_tpu.scripts.cli import build_chrome_trace
+
+    @ray_tpu.remote
+    def hop2():
+        return "leaf"
+
+    @ray_tpu.remote
+    def hop1():
+        return ray_tpu.get(hop2.remote())
+
+    assert ray_tpu.get(hop1.remote(), timeout=60) == "leaf"
+    evs = _events_by_name(["hop1", "hop2"])
+    trace = build_chrome_trace(list(evs.values()))
+
+    slices = {t["name"]: t for t in trace if t["ph"] == "X"}
+    assert slices["hop1"]["args"]["span_id"] == evs["hop1"]["span_id"]
+    assert slices["hop2"]["args"]["parent_span_id"] == \
+        evs["hop1"]["span_id"]
+    # Flow pair: starts inside hop1's slice, finishes at hop2's start,
+    # bound together by the child's span id.
+    starts = [t for t in trace if t["ph"] == "s"]
+    finishes = [t for t in trace if t["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    assert starts[0]["id"] == finishes[0]["id"] == evs["hop2"]["span_id"]
+    assert starts[0]["ts"] == pytest.approx(evs["hop1"]["start"] * 1e6)
+    assert finishes[0]["ts"] == pytest.approx(evs["hop2"]["start"] * 1e6)
+    assert finishes[0]["bp"] == "e"
+
+
+def test_collective_ops_emit_spans_under_task(ray_cluster):
+    """Collective _exchange operations join the task-event stream as
+    spans parented under the rank's running task."""
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def join_and_reduce(self, world):
+            import numpy as np
+
+            from ray_tpu.parallel import collective
+
+            collective.init_collective_group(
+                world, self.rank, backend="store",
+                group_name="span_g")
+            return collective.allreduce(
+                np.ones(2), group_name="span_g").tolist()
+
+    r0, r1 = Rank.remote(0), Rank.remote(1)
+    out = ray_tpu.get([r0.join_and_reduce.remote(2),
+                       r1.join_and_reduce.remote(2)], timeout=120)
+    assert out == [[2.0, 2.0], [2.0, 2.0]]
+
+    deadline = time.time() + 20
+    spans = []
+    while time.time() < deadline:
+        spans = [e for e in ray_tpu.timeline()
+                 if e.get("kind") == "collective"
+                 and "allreduce" in e["name"]]
+        if len(spans) >= 2:
+            break
+        time.sleep(0.2)
+    assert len(spans) >= 2, spans   # one per rank
+    tasks = {e["span_id"]: e for e in ray_tpu.timeline()
+             if e.get("name") == "join_and_reduce"}
+    for s in spans:
+        parent = tasks.get(s["parent_span_id"])
+        assert parent is not None, s
+        assert s["trace_id"] == parent["trace_id"]
+
+
+def test_serve_device_object_round_trip_single_trace(ray_cluster):
+    """ISSUE 8 acceptance: a serve → replica → device-object (KV
+    publish/adopt) round trip produces ONE connected trace spanning the
+    handle hop, the task run, and the KV-cache transfer spans."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class KVEcho:
+        def __call__(self, n):
+            import jax.numpy as jnp
+
+            from ray_tpu.serve.llm.kv_transfer import adopt_kv, publish_kv
+
+            arr = jnp.ones((8, 8), jnp.float32)
+            handoff = publish_kv({"k": arr, "v": arr}, 8, 5)
+            kv = adopt_kv(handoff)
+            return float(kv["k"].sum())
+
+    handle = serve.run(KVEcho.bind(), name="kvecho")
+    try:
+        assert handle.remote(1).result(timeout=120) == 64.0
+
+        deadline = time.time() + 20
+        evs, hops = [], []
+        while time.time() < deadline:
+            evs = ray_tpu.timeline()
+            hops = [e for e in evs if e.get("kind") == "serve_handle"
+                    and "kvecho" in e["name"]]
+            if hops:
+                run_evs = [e for e in evs
+                           if e.get("parent_span_id") ==
+                           hops[0]["span_id"]]
+                dev = [e for e in evs
+                       if e.get("kind") in ("device_put", "device_get")]
+                if run_evs and dev:
+                    break
+            time.sleep(0.2)
+        assert hops, "no serve_handle span reported"
+        hop = hops[0]
+        # handle hop -> replica task run (parent link crosses the hop).
+        runs = [e for e in evs
+                if e.get("parent_span_id") == hop["span_id"]
+                and e.get("kind") == "actor_task"]
+        assert runs, evs
+        run_ev = runs[0]
+        # task run -> KV transfer spans (publish = device_put x2,
+        # adopt = device_get x2), all inside the same trace.
+        kv_spans = [e for e in evs
+                    if e.get("parent_span_id") == run_ev["span_id"]
+                    and e.get("kind") in ("device_put", "device_get")]
+        kinds = {e["kind"] for e in kv_spans}
+        assert kinds == {"device_put", "device_get"}, kv_spans
+        trace_ids = {hop["trace_id"], run_ev["trace_id"]} | \
+            {e["trace_id"] for e in kv_spans}
+        assert len(trace_ids) == 1, trace_ids
+
+        # And the chrome export connects all of it with flow events.
+        from ray_tpu.scripts.cli import build_chrome_trace
+
+        connected = [hop, run_ev] + kv_spans
+        flows = [t for t in build_chrome_trace(connected)
+                 if t["ph"] in ("s", "f")]
+        # one s/f pair per child edge: run under hop + each kv span.
+        assert len(flows) == 2 * (1 + len(kv_spans))
+    finally:
+        serve.shutdown()
+
+
+def test_span_helpers_driverside(ray_cluster):
+    """Driverside spans (no worker executor sink) buffer and flush over
+    the GCS channel; nesting links parents."""
+    from ray_tpu.util import tracing
+
+    with tracing.span("outer_op", kind="bench") as outer_sid:
+        with tracing.span("inner_op", kind="bench"):
+            pass
+    tracing.flush_spans()
+
+    deadline = time.time() + 15
+    evs = {}
+    while time.time() < deadline:
+        evs = {e["name"]: e for e in ray_tpu.timeline()
+               if e.get("kind") == "bench"}
+        if {"outer_op", "inner_op"} <= set(evs):
+            break
+        time.sleep(0.2)
+    assert {"outer_op", "inner_op"} <= set(evs), evs
+    assert evs["inner_op"]["parent_span_id"] == outer_sid
+    assert evs["inner_op"]["trace_id"] == evs["outer_op"]["trace_id"]
+    # Span events must not leak into the TASK views.
+    from ray_tpu.experimental import state
+
+    names = {t["name"] for t in state.list_tasks()}
+    assert "outer_op" not in names and "inner_op" not in names
+    assert "outer_op" not in state.summarize_tasks()
