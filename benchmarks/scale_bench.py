@@ -4,10 +4,22 @@ O(shapes) per event, cluster_task_manager.h:42).
 
 Measures, on one GCS process:
 - sustained submission rate while queueing N INFEASIBLE tasks (they
-  can never place, so this isolates queue/bookkeeping cost);
+  can never place, so this isolates queue/bookkeeping cost), plus the
+  wall time for the fallback waves to finish DRAINING into the GCS
+  (the submit loop is async wrt the GCS since r06; the probe waits for
+  the full queue before measuring placement latency, so the latency
+  metric reflects a settled 100k-deep queue, not a half-ingested one);
 - placement latency of a feasible task submitted BEHIND the N queued
   ones (shape-bucketed queues make this independent of N);
-- actor creation fan-out: K actors created and pinged.
+- actor creation fan-out: K actors created and pinged (decentralized
+  NM-local creation since SCALE_r06);
+- actor CHURN: create/ping/kill cycles, A/B'd over NM-local actor
+  creation (RAY_TPU_LOCAL_ACTOR_CREATION_ENABLED on vs off — the off
+  mode serializes every creation through the central GCS scheduler),
+  mirroring benchmarks/microbench_compare.py conventions (child
+  process per mode, same probe body);
+- multi-driver aggregate throughput (3 driver processes against one
+  GCS).
 
 Prints one JSON line per metric. Run: python benchmarks/scale_bench.py
 [N_tasks] [K_actors].
@@ -15,10 +27,81 @@ Prints one JSON line per metric. Run: python benchmarks/scale_bench.py
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# Child body for the actor-churn A/B: cycles of create-ping-kill. The
+# toggle env is set by the parent per mode (microbench_compare idiom).
+_CHURN_SRC = """
+import json, sys, time
+sys.path.insert(0, {root!r})
+import ray_tpu
+ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+
+@ray_tpu.remote(num_cpus=0)
+class Churner:
+    def ping(self):
+        return 1
+
+# warm the worker pool / zygotes
+warm = [Churner.remote() for _ in range(4)]
+ray_tpu.get([a.ping.remote() for a in warm], timeout=120)
+for a in warm:
+    ray_tpu.kill(a)
+time.sleep(0.5)
+
+cycles, per_cycle = {cycles}, {per_cycle}
+total = 0
+t0 = time.perf_counter()
+for _ in range(cycles):
+    actors = [Churner.remote() for _ in range(per_cycle)]
+    acks = ray_tpu.get([a.ping.remote() for a in actors], timeout=300)
+    assert sum(acks) == per_cycle
+    for a in actors:
+        ray_tpu.kill(a)
+    total += per_cycle
+dt = time.perf_counter() - t0
+print(json.dumps({{"churn_actors_per_s": total / dt,
+                   "n": total, "wall_s": dt}}))
+ray_tpu.shutdown()
+"""
+
+
+def _control_plane_stats(worker_mod):
+    w = worker_mod.global_worker()
+    return w.gcs.request("control_plane_stats", timeout=30)
+
+
+def _run_churn_child(enabled: bool, cycles: int, per_cycle: int) -> dict:
+    env = dict(os.environ)
+    env["RAY_TPU_LOCAL_ACTOR_CREATION_ENABLED"] = "1" if enabled else "0"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("PALLAS_AXON_POOL_IPS", "")
+    src = _CHURN_SRC.format(
+        root=os.path.dirname(HERE), cycles=cycles, per_cycle=per_cycle)
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(src)
+        path = f.name
+    try:
+        proc = subprocess.run([sys.executable, path], capture_output=True,
+                              text=True, timeout=900, env=env)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError(
+            f"churn child produced no result (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}")
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
 
 def main():
@@ -28,6 +111,7 @@ def main():
     import ray_tpu
 
     ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    from ray_tpu._private import worker as worker_mod
     try:
         @ray_tpu.remote(resources={"impossible": 1})
         def never():
@@ -43,10 +127,20 @@ def main():
         t0 = time.perf_counter()
         queued = [never.remote() for _ in range(n_tasks)]
         dt = time.perf_counter() - t0
+        # The submit loop is driver-side async: fallback waves are still
+        # draining into the GCS. Barrier on the full queue so the next
+        # probe measures placement behind a SETTLED n_tasks-deep queue.
+        t_drain = time.perf_counter()
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if _control_plane_stats(worker_mod)["queued_tasks"] >= n_tasks:
+                break
+            time.sleep(0.1)
+        drain_s = time.perf_counter() - t_drain
         print(json.dumps({
             "metric": "infeasible_queue_submit_per_s",
             "value": round(n_tasks / dt, 1), "unit": "tasks/s",
-            "n": n_tasks}), flush=True)
+            "n": n_tasks, "gcs_drain_s": round(drain_s, 2)}), flush=True)
 
         # Placement behind the queue: shape-bucketed scheduling means the
         # N queued infeasible tasks cost O(1) shapes per event, so this
@@ -64,7 +158,17 @@ def main():
             "p95_ms": round(1000 * lat[int(len(lat) * 0.95)], 2),
             "queued_behind": n_tasks}), flush=True)
 
-        del queued  # refcount flush churn happens in the background
+        del queued
+        # Let the 100k-ref decref flush drain before the actor phases so
+        # they measure actor-path cost, not leftover refcount churn.
+        w = worker_mod.global_worker()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with w._refs._lock:
+                left = len(w._refs._pending)
+            if left == 0:
+                break
+            time.sleep(0.1)
 
         @ray_tpu.remote(num_cpus=0)
         class Pinger:
@@ -87,11 +191,6 @@ def main():
         # the SAME GCS with task waves (the reference's many-client
         # regime; SCALE_r04 only ever measured one driver). Reports
         # aggregate throughput and the worst per-driver p95.
-        import subprocess
-        import tempfile
-
-        from ray_tpu._private import worker as worker_mod
-
         address = worker_mod.global_worker().gcs_address
         n_drivers, per_driver = 3, 600
         child_src = f"""
@@ -167,6 +266,24 @@ ray_tpu.shutdown()
                 "error": "all child drivers failed"}), flush=True)
     finally:
         ray_tpu.shutdown()
+
+    # Actor churn A/B (own clusters per mode, clean state; the toggle
+    # env reaches both the driver and its spawned control plane).
+    cycles, per_cycle = 3, max(20, min(100, k_actors // 2))
+    on = _run_churn_child(True, cycles, per_cycle)
+    off = _run_churn_child(False, cycles, per_cycle)
+    print(json.dumps({
+        "metric": "actor_churn_per_s",
+        "value": round(on["churn_actors_per_s"], 2),
+        "unit": "actors/s (create+ping+kill cycles)",
+        "cycles": cycles, "per_cycle": per_cycle,
+        "ab": {
+            "local_actor_creation_on": round(on["churn_actors_per_s"], 2),
+            "local_actor_creation_off": round(off["churn_actors_per_s"], 2),
+            "speedup": round(on["churn_actors_per_s"]
+                             / max(off["churn_actors_per_s"], 1e-9), 2),
+            "toggle": "RAY_TPU_LOCAL_ACTOR_CREATION_ENABLED",
+        }}), flush=True)
 
 
 if __name__ == "__main__":

@@ -209,6 +209,16 @@ _d("local_lease_backoff_s", 1.0,
    "After the GCS signals classic-queue pressure (revoke_local_lease), "
    "the node manager declines overlapping local grants for this long so "
    "spilled-back work drains through the fair central queue first.")
+_d("local_actor_creation_enabled", True,
+   "Decentralized actor creation (the actor analog of local-first task "
+   "leases): the driver asks its OWN node manager to place eligible "
+   "actors (no PG/affinity/name/TPU/runtime_env) from the node's local "
+   "ledger — worker checkout via the zygote/pool, resources carried in "
+   "the local_held heartbeat aggregate — and the GCS learns of the "
+   "placement asynchronously (actor_placed). Declines (capacity, "
+   "fairness backoff, ineligible shape) spill back to the classic "
+   "GCS-scheduled creation path. Off = every actor creation serializes "
+   "through the central scheduler.")
 
 # --- direct task transport (worker leases) ---------------------------------
 _d("lease_enabled", True,
@@ -232,6 +242,13 @@ _d("worker_zygote_enabled", True,
    "fresh python interpreter per spawn (~10x cheaper under actor "
    "bursts). TPU workers always use the classic spawn path (PJRT "
    "plugin registration happens at interpreter start).")
+_d("worker_zygote_count", 4,
+   "Fork-servers per node manager. One zygote serializes spawns behind "
+   "a single ~10-30ms fork conversation (fork of a jax-preloaded image "
+   "is page-table-bound); K zygotes let an actor-churn or scale-out "
+   "burst fork K workers concurrently. Each zygote is one idle "
+   "pre-imported python process of resident memory — lower this on "
+   "memory-tight nodes.")
 _d("tpu_worker_idle_timeout_s", 300.0,
    "A chip-bound worker parked between same-shape TPU tasks is retired "
    "after this idle time (its chips return to the node free list). "

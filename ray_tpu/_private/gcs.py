@@ -11,10 +11,43 @@ here the GCS owns the authoritative resource view and leases tasks to node
 managers), the object directory (ownership_based_object_directory.h:37), and
 task events (gcs_task_manager.h:61).
 
-Threading model: handlers run on per-connection listener threads; all state
-is guarded by one lock (the analog of the reference's single-threaded asio
-loop, common/asio/). Handlers never block while holding the lock — deferred
-replies are parked and fulfilled by later events or the timer thread.
+Threading model: handlers run on per-connection listener threads; state is
+sharded into four independently-locked domains (the reference instead runs
+one asio loop, common/asio/ — here domain shards let KV reads, refcount
+flushes, object-directory updates, and scheduling proceed in parallel):
+
+  rank 0  ``_sched_lock``  nodes ledger + resource accounting, task queues,
+                           running tasks, worker leases, clients/jobs
+  rank 1  ``_actor_lock``  actor directory + lifecycle, placement groups
+  rank 2  ``_obj_lock``    object directory, dep-waiting tasks, refcounts,
+                           task-arg pins, lineage, parked object waiters
+  rank 3  ``_kv_lock``     function store, KV, metrics table, task events,
+                           pubsub subscriptions
+
+Lock discipline (enforced by raylint's lock-order checker and the runtime
+lockdep witness): a thread holding a shard lock may only acquire a HIGHER
+rank shard lock (sched -> actor -> obj -> kv), never a lower one — all
+edges point rank-forward, so the acquisition graph cannot cycle. Handlers
+acquire their primary shard(s) up front in canonical order (``with
+self._sched_lock, self._obj_lock:``); helpers may nest forward. The few
+genuinely cross-domain paths (node death, driver exit, actor restart) take
+every shard they touch up front, again in canonical order. Paths that
+would need a LOWER-rank lock run two-phase instead: collect under the
+higher shard, release, then act under the lower one (e.g. lease-path
+object reports waking dep-parked tasks).
+
+Routing reads — looking up a node's conn/address purely to SEND it a
+message — read the ``_nodes``/``_clients`` dicts without a lock (atomic
+under the GIL; entries are never mutated in place for routing fields, and
+a stale conn surfaces as the caught ConnectionClosed every send site
+already handles). Resource accounting always runs under ``_sched_lock``.
+
+Pubsub publishes and death notifications never happen under any shard
+lock: ``_publish`` records into an outbox drained by a dedicated
+publisher thread (record-then-publish).
+
+Handlers never block while holding a lock — deferred replies are parked
+and fulfilled by later events or the timer thread.
 """
 
 from __future__ import annotations
@@ -89,6 +122,12 @@ class ActorEntry:
     death_cause: str = ""
     waiters: List[Tuple[protocol.Conn, int]] = field(default_factory=list)
     pending_tasks: List[ActorTaskSpec] = field(default_factory=list)
+    # Decentralized creation: the node manager placed this actor from
+    # its OWN ledger (resources ride the local_held heartbeat aggregate,
+    # never acquired centrally) — GCS release paths must skip the
+    # central ledger for it. Cleared the moment the GCS re-places the
+    # actor itself (restart after node death).
+    local_placement: bool = False
 
 
 @dataclass
@@ -192,7 +231,12 @@ class GcsServer:
                  storage_path: Optional[str] = None):
         from ray_tpu._private.config import config as _cfg
 
-        self._lock = threading.RLock()
+        # Domain shard locks — canonical rank order (see module
+        # docstring): sched < actor < obj < kv. Acquire forward only.
+        self._sched_lock = threading.RLock()
+        self._actor_lock = threading.RLock()
+        self._obj_lock = threading.RLock()
+        self._kv_lock = threading.RLock()
         # Durable table storage (reference: redis_store_client.h:28 +
         # GcsInitData restore). Enabled by passing storage_path or setting
         # gcs_storage=file + gcs_file_storage_path.
@@ -227,6 +271,11 @@ class GcsServer:
         # actors
         self._actors: Dict[bytes, ActorEntry] = {}
         self._named_actors: Dict[Tuple[str, str], bytes] = {}
+        # Kill-before-placement tombstones (decentralized creation race:
+        # ray.kill can reach the GCS before the NM's actor_placed report
+        # does). Bounded FIFO; actor_placed completes the kill.
+        self._killed_before_placed: "collections.OrderedDict[bytes, float]" \
+            = collections.OrderedDict()
 
         # placement groups
         self._pgs: Dict[bytes, PgEntry] = {}
@@ -271,6 +320,15 @@ class GcsServer:
         # task events ring buffer (reference: gcs_task_manager.h bounded store)
         self._task_events: collections.deque = collections.deque(maxlen=100_000)
 
+        # Record-then-publish outbox (kv domain's background work):
+        # lifecycle paths record (channel, message) — often while holding
+        # a shard lock — and the publisher thread fans out to
+        # subscribers, so no pubsub notify ever runs under a GCS state
+        # lock (a slow subscriber socket can no longer stall the
+        # control plane).
+        self._pub_q: collections.deque = collections.deque()
+        self._pub_ev = threading.Event()
+
         self._shutdown = threading.Event()
         if self._storage is not None:
             self._load_from_storage()
@@ -281,13 +339,17 @@ class GcsServer:
         self._timer = threading.Thread(target=self._timer_loop, daemon=True,
                                        name="rtpu-gcs-timer")
         self._timer.start()
+        self._publisher = threading.Thread(target=self._publisher_loop,
+                                           daemon=True, name="rtpu-gcs-pub")
+        self._publisher.start()
 
     # ------------------------------------------------------------------ util
 
     def close(self):
         self._shutdown.set()
+        self._pub_ev.set()
         # Tell node managers to tear down their worker pools.
-        with self._lock:
+        with self._sched_lock:
             nodes = list(self._nodes.values())
         for n in nodes:
             try:
@@ -304,6 +366,7 @@ class GcsServer:
         their worker pools and rejoin the restarted head). Reference role:
         the GCS-failover release tests killing gcs_server."""
         self._shutdown.set()
+        self._pub_ev.set()
         self.server.close()
         if self._storage is not None:
             self._storage.close()
@@ -311,14 +374,22 @@ class GcsServer:
     def _timer_loop(self):
         while not self._shutdown.wait(0.05):
             now = time.time()
-            with self._lock:
+            # Object-domain housekeeping: waiter deadlines + deferred
+            # frees. Delete notifications collected under the lock go
+            # out after it is released.
+            deletes: Dict[str, List[bytes]] = {}
+            with self._obj_lock:
                 expired = [w for w in self._obj_waiters
                            if w.deadline is not None and now >= w.deadline]
                 for w in expired:
                     self._obj_waiters.remove(w)
                 due = [o for o, t in self._pending_free.items() if now >= t]
                 if due:
-                    self._free_now(due)
+                    deletes = self._free_now(due)
+            self._send_deletes(deletes)
+            # Scheduling-domain housekeeping. Health checks / recovering-
+            # actor expiry nest actor (and obj, for node death) forward.
+            with self._sched_lock:
                 self._check_health(now)
                 if self._recovering_actors:
                     self._expire_recovering_actors(now)
@@ -329,6 +400,7 @@ class GcsServer:
                     # this keeps revocation/fairness progressing.
                     self._last_queue_retry = now
                     self._try_schedule()
+            self._sample_shard_metrics(now)
             for w in expired:
                 try:
                     w.conn.reply(w.msg_id, {
@@ -338,6 +410,79 @@ class GcsServer:
                     })
                 except Exception:
                     pass
+
+    # --------------------------------------------- per-shard observability
+
+    _SHARD_SAMPLE_PERIOD_S = 1.0
+
+    def _sample_shard_metrics(self, now: float) -> None:
+        """Sampled shard-contention probe (timer thread, ~1/s): time a
+        fresh acquire of each shard lock into
+        ``gcs_shard_lock_wait_seconds`` and export per-domain queue
+        depths as ``gcs_shard_queue_depth``. Sampling — rather than
+        per-acquire instrumentation — keeps metric bookkeeping entirely
+        off the handler hot paths; under contention the probe's own
+        acquire waits exactly like a handler would, which is the signal
+        we want."""
+        if now - getattr(self, "_last_shard_sample", 0.0) < \
+                self._SHARD_SAMPLE_PERIOD_S:
+            return
+        self._last_shard_sample = now
+        try:
+            wait_h, depth_g = _shard_metrics()
+        except Exception:
+            return
+
+        def depth_sched():
+            return len(self._queued_tasks)
+
+        def depth_actor():
+            return sum(1 for e in self._actors.values()
+                       if e.state in (PENDING_CREATION, RESTARTING)
+                       and e.node_id is None)
+
+        def depth_obj():
+            return len(self._obj_waiters) + len(self._pending_free)
+
+        def depth_kv():
+            return len(self._pub_q)
+
+        for name, lock, depth in (
+                ("sched", self._sched_lock, depth_sched),
+                ("actor", self._actor_lock, depth_actor),
+                ("obj", self._obj_lock, depth_obj),
+                ("kv", self._kv_lock, depth_kv)):
+            t0 = time.perf_counter()
+            with lock:
+                wait_h.observe(time.perf_counter() - t0,
+                               tags={"shard": name})
+                depth_g.set(float(depth()), tags={"shard": name})
+
+    def _publisher_loop(self):
+        """Drain the record-then-publish outbox: snapshot each message's
+        subscriber set under the kv shard, send outside every lock."""
+        while not self._shutdown.is_set():
+            # raylint: disable-next=unbounded-wait (dedicated publisher
+            # thread parked for outbox work; close() sets the event)
+            self._pub_ev.wait()
+            self._pub_ev.clear()
+            while self._pub_q:
+                try:
+                    channel, message = self._pub_q.popleft()
+                except IndexError:
+                    break
+                with self._kv_lock:
+                    targets = [c for c in list(self._clients.values())
+                               if channel in c.meta.get("subscriptions", ())]
+                    targets += [n.conn for n in list(self._nodes.values())
+                                if n.alive and channel in
+                                n.conn.meta.get("subscriptions", ())]
+                for c in targets:
+                    try:
+                        c.notify("pubsub", {"channel": channel,
+                                            "message": message})
+                    except Exception:
+                        pass
 
     # ------------------------------------------- persistence + fault tolerance
 
@@ -364,6 +509,7 @@ class GcsServer:
             "node_id": entry.node_id, "restarts_left": entry.restarts_left,
             "num_restarts": entry.num_restarts,
             "death_cause": entry.death_cause,
+            "local_placement": entry.local_placement,
         })
 
     def _load_from_storage(self):
@@ -396,7 +542,8 @@ class GcsServer:
                 spec=snap["spec"], state=snap["state"],
                 node_id=None, restarts_left=snap["restarts_left"],
                 num_restarts=snap["num_restarts"],
-                death_cause=snap["death_cause"])
+                death_cause=snap["death_cause"],
+                local_placement=bool(snap.get("local_placement")))
             if entry.state not in (DEAD,):
                 entry.state = RESTARTING
                 self._recovering_actors[aid] = grace
@@ -434,9 +581,26 @@ class GcsServer:
                                now - n.last_heartbeat)
                 self._mark_node_dead(node_id)
 
+    @staticmethod
+    def _merge_local_held(node: NodeEntry, p: dict) -> bool:
+        """Apply a node's local_held report (heartbeat OR actor_placed —
+        both ends of the protocol share this rule). Reports are sent
+        outside the NM's lock, so they can arrive out of order: the seq
+        keeps a stale (older) snapshot from overwriting a fresher one.
+        Returns True when held resources SHRANK (capacity came back).
+        Caller holds _sched_lock."""
+        seq = p.get("local_held_seq", -1)
+        if not (seq == -1 or seq > node.local_held_seq):
+            return False
+        node.local_held_seq = max(seq, node.local_held_seq)
+        new = ResourceSet(p["local_held"])
+        old = node.local_held.to_dict()
+        node.local_held = new
+        return any(new.get(k) < v for k, v in old.items())
+
     def _h_heartbeat(self, conn, p, msg_id):
         freed = False
-        with self._lock:
+        with self._sched_lock:
             node = self._nodes.get(p["node_id"])
             if node is not None:
                 node.last_heartbeat = time.time()
@@ -446,35 +610,27 @@ class GcsServer:
                     node.hw = p["hw"]
                 if "local_held" in p:
                     # Async resource delta from the node's local-first
-                    # scheduler: reconcile the central view. Reports are
-                    # sent outside the NM's lock, so they can arrive out
-                    # of order — the seq keeps a stale (older) snapshot
-                    # from overwriting a fresher one. Held resources
-                    # shrinking means capacity came back — queued
-                    # central work may now place.
-                    seq = p.get("local_held_seq", -1)
-                    if seq == -1 or seq > node.local_held_seq:
-                        node.local_held_seq = max(seq,
-                                                  node.local_held_seq)
-                        new = ResourceSet(p["local_held"])
-                        old = node.local_held.to_dict()
-                        node.local_held = new
-                        freed = any(new.get(k) < v
-                                    for k, v in old.items())
+                    # scheduler: reconcile the central view. Held
+                    # resources shrinking means capacity came back —
+                    # queued central work may now place.
+                    freed = self._merge_local_held(node, p)
             if freed:
                 self._try_schedule()
 
     def _expire_recovering_actors(self, now: float):
-        due = [aid for aid, t in self._recovering_actors.items() if now >= t]
-        for aid in due:
-            self._recovering_actors.pop(aid, None)
-            entry = self._actors.get(aid)
-            if entry is not None and entry.state == RESTARTING \
-                    and entry.node_id is None:
-                # Node never rejoined: equivalent to node death.
-                if not self._schedule_actor(entry):
-                    self._queued_tasks.append(_ActorCreationShim(entry))
-                self._persist_actor(aid)
+        # Caller holds _sched_lock; actor nests forward.
+        with self._actor_lock:
+            due = [aid for aid, t in self._recovering_actors.items()
+                   if now >= t]
+            for aid in due:
+                self._recovering_actors.pop(aid, None)
+                entry = self._actors.get(aid)
+                if entry is not None and entry.state == RESTARTING \
+                        and entry.node_id is None:
+                    # Node never rejoined: equivalent to node death.
+                    if not self._schedule_actor(entry):
+                        self._queued_tasks.append(_ActorCreationShim(entry))
+                    self._persist_actor(aid)
 
     # ------------------------------------------------------------- dispatch
 
@@ -494,18 +650,34 @@ class GcsServer:
                 pass
 
     def _on_disconnect(self, conn: protocol.Conn):
+        """Deferred to a fresh thread: conn.close() fires this callback
+        INLINE from whatever thread noticed the failure — including a
+        handler that is holding a high-rank shard lock (e.g. a waiter
+        reply under _obj_lock hitting a dead socket). Running the
+        cross-shard cleanup there would acquire rank-backward; the old
+        global RLock masked exactly this via reentrancy. Disconnects are
+        rare (node/client death), so a short-lived thread is cheap."""
+        threading.Thread(target=self._handle_disconnect, args=(conn,),
+                         daemon=True, name="rtpu-gcs-disc").start()
+
+    def _handle_disconnect(self, conn: protocol.Conn):
+        """Cross-shard path (ordered protocol): node/driver death touches
+        scheduling, actors, and object state — acquire every shard it
+        needs up front, in canonical rank order."""
         role = conn.meta.get("role")
-        with self._lock:
-            if role == "node":
-                node_id = conn.meta.get("node_id")
+        if role == "node":
+            node_id = conn.meta.get("node_id")
+            with self._sched_lock:
                 self._mark_node_dead(node_id)
-            elif role in ("driver", "worker"):
-                cid = conn.meta.get("client_id")
-                self._clients.pop(cid, None)
-                self._drop_client_refs(cid)
-                self._release_client_leases_locked(cid)
-                if role == "driver":
-                    self._on_driver_exit(cid)
+        elif role in ("driver", "worker"):
+            cid = conn.meta.get("client_id")
+            with self._sched_lock:
+                with self._actor_lock, self._obj_lock:
+                    self._clients.pop(cid, None)
+                    self._drop_client_refs(cid)
+                    self._release_client_leases_locked(cid)
+                    if role == "driver":
+                        self._on_driver_exit(cid)
                 self._try_schedule()
 
     def _on_driver_exit(self, client_id: str):
@@ -522,53 +694,62 @@ class GcsServer:
                                         cause="owner driver exited")
 
     def _mark_node_dead(self, node_id: Optional[str]):
+        """Cross-shard path (ordered protocol). Caller holds _sched_lock;
+        actor + obj are taken here, rank-forward, for the whole teardown
+        so no handler observes a node half-dead."""
         node = self._nodes.get(node_id) if node_id else None
         if node is None or not node.alive:
             return
-        node.alive = False
-        logger.warning("node %s died", node_id)
-        self._drop_client_refs(f"node:{node_id[:12]}")
-        # Leases on the dead node die with it (resources went with the node;
-        # holders notice their direct conns closing and fall back). The
-        # node manager's own local-first grants die the same way — clear
-        # the held aggregate so fairness never chases a dead node.
-        node.local_held = ResourceSet()
-        for lid, lease in list(self._leases.items()):
-            if lease["node_id"] == node_id:
-                self._leases.pop(lid, None)
-        # Drop object locations on that node. For objects whose LAST copy
-        # just died and that something still wants (live refs, task-arg
-        # pins, or parked waiters), re-run the producing task — lineage
-        # reconstruction (reference: object_recovery_manager.h:41).
-        for oid, locs in list(self._obj_locations.items()):
-            locs.discard(node_id)
-            sp = self._spilled_objects.get(oid)
-            if sp is not None and sp.get("node_id") == node_id:
-                self._spilled_objects.pop(oid, None)
-            if not locs:
-                wanted = (
-                    (self._refcount_total(oid) or 0) > 0
-                    or self._task_arg_pins.get(oid)
-                    or any(oid in w.pending for w in self._obj_waiters))
-                if wanted:
-                    self._try_reconstruct(oid)
-        # Fail running tasks on that node (retry if budget remains).
-        for tid, (spec, n) in list(self._running_tasks.items()):
-            if n == node_id:
-                del self._running_tasks[tid]
-                self._handle_task_failure(spec, "node died")
-        # Restart / fail actors on that node.
-        for aid, entry in self._actors.items():
-            if entry.node_id == node_id and entry.state in (ALIVE, PENDING_CREATION):
-                self._on_actor_down(aid, "node died")
+        with self._actor_lock, self._obj_lock:
+            node.alive = False
+            logger.warning("node %s died", node_id)
+            self._drop_client_refs(f"node:{node_id[:12]}")
+            # Leases on the dead node die with it (resources went with the
+            # node; holders notice their direct conns closing and fall
+            # back). The node manager's own local-first grants die the
+            # same way — clear the held aggregate so fairness never
+            # chases a dead node.
+            node.local_held = ResourceSet()
+            for lid, lease in list(self._leases.items()):
+                if lease["node_id"] == node_id:
+                    self._leases.pop(lid, None)
+            # Drop object locations on that node. For objects whose LAST
+            # copy just died and that something still wants (live refs,
+            # task-arg pins, or parked waiters), re-run the producing
+            # task — lineage reconstruction (reference:
+            # object_recovery_manager.h:41).
+            for oid, locs in list(self._obj_locations.items()):
+                locs.discard(node_id)
+                sp = self._spilled_objects.get(oid)
+                if sp is not None and sp.get("node_id") == node_id:
+                    self._spilled_objects.pop(oid, None)
+                if not locs:
+                    wanted = (
+                        (self._refcount_total(oid) or 0) > 0
+                        or self._task_arg_pins.get(oid)
+                        or any(oid in w.pending for w in self._obj_waiters))
+                    if wanted:
+                        self._try_reconstruct(oid)
+            # Fail running tasks on that node (retry if budget remains).
+            for tid, (spec, n) in list(self._running_tasks.items()):
+                if n == node_id:
+                    del self._running_tasks[tid]
+                    self._handle_task_failure(spec, "node died")
+            # Restart / fail actors on that node.
+            for aid, entry in self._actors.items():
+                if entry.node_id == node_id and \
+                        entry.state in (ALIVE, PENDING_CREATION):
+                    self._on_actor_down(aid, "node died")
         # Retried tasks and restarting actors were re-enqueued above —
-        # dispatch them onto the surviving nodes now.
+        # dispatch them onto the surviving nodes now, with actor+obj
+        # released (the scheduler re-nests them rank-forward; caller
+        # still holds _sched_lock).
         self._try_schedule()
 
     # --------------------------------------------------------- registration
 
     def _h_register_client(self, conn, p, msg_id):
-        with self._lock:
+        with self._sched_lock:
             cid = p["client_id"]
             conn.meta["role"] = p["role"]
             conn.meta["client_id"] = cid
@@ -604,7 +785,16 @@ class GcsServer:
             })
 
     def _h_register_node(self, conn, p, msg_id):
-        with self._lock:
+        # Cross-shard: node join re-reports actors (actor shard) and
+        # store contents (obj shard) atomically with the ledger entry.
+        with self._sched_lock, self._actor_lock:
+            self._h_register_node_inner(conn, p, msg_id)
+            self._try_schedule()
+            self._try_schedule_pgs()
+
+    def _h_register_node_inner(self, conn, p, msg_id):
+        # Caller holds _sched_lock + _actor_lock; obj nests forward.
+        with self._obj_lock:
             entry = NodeEntry(
                 node_id=p["node_id"],
                 address=p["address"],
@@ -631,16 +821,17 @@ class GcsServer:
                 if a is not None and a.state != DEAD and a.node_id is None:
                     a.state = ALIVE
                     a.node_id = p["node_id"]
-                    entry.available.acquire(a.spec.resources)
+                    if not a.local_placement:
+                        # NM-placed actors' resources arrive in the
+                        # node's local_held aggregate, never centrally.
+                        entry.available.acquire(a.spec.resources)
                     self._recovering_actors.pop(aid, None)
                     self._persist_actor(aid)
                     self._reply_actor_waiters(a)
             conn.reply(msg_id, {"ok": True})
-            self._try_schedule()
-            self._try_schedule_pgs()
 
     def _h_nodes(self, conn, p, msg_id):
-        with self._lock:
+        with self._sched_lock:
             out = []
             for n in self._nodes.values():
                 out.append({
@@ -658,7 +849,7 @@ class GcsServer:
             conn.reply(msg_id, out)
 
     def _h_cluster_resources(self, conn, p, msg_id):
-        with self._lock:
+        with self._sched_lock:
             total = ResourceSet()
             for n in self._nodes.values():
                 if n.alive:
@@ -666,7 +857,7 @@ class GcsServer:
             conn.reply(msg_id, total.to_dict())
 
     def _h_available_resources(self, conn, p, msg_id):
-        with self._lock:
+        with self._sched_lock:
             total = ResourceSet()
             for n in self._nodes.values():
                 if n.alive:
@@ -676,21 +867,21 @@ class GcsServer:
     # ------------------------------------------------------ function store
 
     def _h_put_function(self, conn, p, msg_id):
-        with self._lock:
+        with self._kv_lock:
             if p["key"] not in self._functions:
                 self._functions[p["key"]] = p["blob"]
                 self._persist("functions", p["key"].encode(), p["blob"])
         conn.reply(msg_id, True)
 
     def _h_get_function(self, conn, p, msg_id):
-        with self._lock:
+        with self._kv_lock:
             blob = self._functions.get(p["key"])
         conn.reply(msg_id, blob)
 
     # ----------------------------------------------------------------- KV
 
     def _h_kv_put(self, conn, p, msg_id):
-        with self._lock:
+        with self._kv_lock:
             ns = self._kv[p.get("ns", "")]
             if not p.get("overwrite", True) and p["key"] in ns:
                 conn.reply(msg_id, False)
@@ -701,11 +892,11 @@ class GcsServer:
         conn.reply(msg_id, True)
 
     def _h_kv_get(self, conn, p, msg_id):
-        with self._lock:
+        with self._kv_lock:
             conn.reply(msg_id, self._kv[p.get("ns", "")].get(p["key"]))
 
     def _h_kv_del(self, conn, p, msg_id):
-        with self._lock:
+        with self._kv_lock:
             existed = self._kv[p.get("ns", "")].pop(p["key"], None) is not None
             if existed:
                 self._persist_delete(
@@ -713,36 +904,43 @@ class GcsServer:
             conn.reply(msg_id, existed)
 
     def _h_kv_exists(self, conn, p, msg_id):
-        with self._lock:
+        with self._kv_lock:
             conn.reply(msg_id, p["key"] in self._kv[p.get("ns", "")])
 
     def _h_kv_keys(self, conn, p, msg_id):
         pref = p.get("prefix", b"")
-        with self._lock:
+        with self._kv_lock:
             conn.reply(msg_id, [k for k in self._kv[p.get("ns", "")]
                                 if k.startswith(pref)])
 
     # ------------------------------------------------------ task scheduling
 
     def _deps_ready(self, deps: List[ObjectID]) -> bool:
+        # Caller holds _obj_lock.
         return all(d.binary() in self._obj_locations
                    and self._obj_locations[d.binary()] for d in deps)
 
     def _unready_deps(self, deps: List[ObjectID]):
+        # Caller holds _obj_lock.
         return [d for d in deps
                 if not self._obj_locations.get(d.binary())]
 
     def _h_submit_task(self, conn, spec: TaskSpec, msg_id):
-        with self._lock:
-            spec.retries_left = spec.max_retries
-            # Retain the spec for lineage reconstruction; pin its args so
-            # refcount-zero deps can't be freed out from under it. The
-            # table is LRU-bounded: evicting old lineage turns a later
-            # reconstruction attempt into a clean ObjectLost error
-            # (reference: lineage eviction once refs go out of scope).
-            self._retain_spec_locked(spec)
-            self._pin_task_args(spec)
-            self._enqueue_task(spec)
+        # obj closes before _try_schedule: the scheduler acquires the
+        # actor shard for pending creations, and actor ranks BELOW obj —
+        # never acquire rank-backward (see module docstring).
+        with self._sched_lock:
+            with self._obj_lock:
+                spec.retries_left = spec.max_retries
+                # Retain the spec for lineage reconstruction; pin its
+                # args so refcount-zero deps can't be freed out from
+                # under it. The table is LRU-bounded: evicting old
+                # lineage turns a later reconstruction attempt into a
+                # clean ObjectLost error (reference: lineage eviction
+                # once refs go out of scope).
+                self._retain_spec_locked(spec)
+                self._pin_task_args(spec)
+                self._enqueue_task(spec)
             self._try_schedule()
 
     def _h_submit_tasks(self, conn, specs: List[TaskSpec], msg_id):
@@ -751,21 +949,26 @@ class GcsServer:
         burst drains in hundreds of handler invocations instead of 100k
         — the probe RPC queued behind it waits milliseconds, not
         seconds."""
-        with self._lock:
-            for spec in specs:
-                spec.retries_left = spec.max_retries
-                self._retain_spec_locked(spec)
-                self._pin_task_args(spec)
-                self._enqueue_task(spec)
+        with self._sched_lock:
+            with self._obj_lock:
+                for spec in specs:
+                    spec.retries_left = spec.max_retries
+                    self._retain_spec_locked(spec)
+                    self._pin_task_args(spec)
+                    self._enqueue_task(spec)
             self._try_schedule()
 
     def _enqueue_task(self, spec: TaskSpec):
-        unready = self._unready_deps(spec.arg_deps)
-        if unready:
-            for d in unready:
-                self._waiting_tasks[d.binary()].append(spec)
-        else:
-            self._queued_tasks.append(spec)
+        # Caller holds _sched_lock; obj nests forward for the dep check
+        # (check-and-park is atomic under _obj_lock, so a concurrent
+        # location add can't slip between the check and the parking).
+        with self._obj_lock:
+            unready = self._unready_deps(spec.arg_deps)
+            if unready:
+                for d in unready:
+                    self._waiting_tasks[d.binary()].append(spec)
+                return
+        self._queued_tasks.append(spec)
 
     def _pick_node(self, resources: Dict[str, float],
                    strategy: Any = None,
@@ -812,48 +1015,56 @@ class GcsServer:
                    key=lambda n: n.effective_available().utilization(n.total))
 
     def _acquire_for(self, spec, node: NodeEntry) -> bool:
-        """Reserve resources on a node (or its PG bundle)."""
+        """Reserve resources on a node (or its PG bundle). Caller holds
+        _sched_lock; the PG branch nests the actor shard forward."""
         if spec.placement_group_id is not None:
-            pg = self._pgs.get(spec.placement_group_id.binary())
-            if pg is None or pg.state != "CREATED":
-                return False
-            idx = spec.placement_group_bundle_index
-            if idx < 0:
-                # any bundle on this node with capacity
-                for i, avail in pg.bundle_available.items():
-                    if (pg.spec.bundles[i].node_id == node.node_id
-                            and avail.fits(spec.resources)):
-                        idx = i
-                        break
-                else:
+            with self._actor_lock:
+                pg = self._pgs.get(spec.placement_group_id.binary())
+                if pg is None or pg.state != "CREATED":
                     return False
-                spec.placement_group_bundle_index = idx
-            return pg.bundle_available[idx].acquire(spec.resources)
+                idx = spec.placement_group_bundle_index
+                if idx < 0:
+                    # any bundle on this node with capacity
+                    for i, avail in pg.bundle_available.items():
+                        if (pg.spec.bundles[i].node_id == node.node_id
+                                and avail.fits(spec.resources)):
+                            idx = i
+                            break
+                    else:
+                        return False
+                    spec.placement_group_bundle_index = idx
+                return pg.bundle_available[idx].acquire(spec.resources)
         return node.available.acquire(spec.resources)
 
     def _release_for(self, spec, node_id: str):
+        # Caller holds _sched_lock; PG branch nests actor forward.
         if spec.placement_group_id is not None:
-            pg = self._pgs.get(spec.placement_group_id.binary())
-            if pg is not None and spec.placement_group_bundle_index >= 0:
-                avail = pg.bundle_available.get(spec.placement_group_bundle_index)
-                if avail is not None:
-                    avail.release(spec.resources)
+            with self._actor_lock:
+                pg = self._pgs.get(spec.placement_group_id.binary())
+                if pg is not None and \
+                        spec.placement_group_bundle_index >= 0:
+                    avail = pg.bundle_available.get(
+                        spec.placement_group_bundle_index)
+                    if avail is not None:
+                        avail.release(spec.resources)
             return
         node = self._nodes.get(node_id)
         if node is not None:
             node.available.release(spec.resources)
 
     def _node_for_pg_task(self, spec) -> Optional[NodeEntry]:
-        pg = self._pgs.get(spec.placement_group_id.binary())
-        if pg is None or pg.state != "CREATED":
-            return None
-        idx = spec.placement_group_bundle_index
-        for i, b in enumerate(pg.spec.bundles):
-            if idx >= 0 and i != idx:
-                continue
-            if (b.node_id in self._nodes
-                    and pg.bundle_available[i].fits(spec.resources)):
-                return self._nodes[b.node_id]
+        # Caller holds _sched_lock; actor nests forward for the PG table.
+        with self._actor_lock:
+            pg = self._pgs.get(spec.placement_group_id.binary())
+            if pg is None or pg.state != "CREATED":
+                return None
+            idx = spec.placement_group_bundle_index
+            for i, b in enumerate(pg.spec.bundles):
+                if idx >= 0 and i != idx:
+                    continue
+                if (b.node_id in self._nodes
+                        and pg.bundle_available[i].fits(spec.resources)):
+                    return self._nodes[b.node_id]
         return None
 
     def _try_schedule(self):
@@ -864,6 +1075,9 @@ class GcsServer:
         that one check — cost per event is O(shapes x nodes +
         dispatched), independent of how many tasks are queued (reference:
         cluster_task_manager.h:42 scheduling classes).
+
+        Caller holds _sched_lock; actor nests forward for pending actor
+        creations / PG tasks, obj for failing cancelled specs.
         """
         if not self._nodes:
             return
@@ -874,15 +1088,19 @@ class GcsServer:
                 if spec is None:
                     break
                 if isinstance(spec, _ActorCreationShim):
-                    entry = self._actors.get(spec.actor_id.binary())
-                    if entry is not None and entry.node_id is None and \
-                            entry.state in (PENDING_CREATION,
-                                            DEPENDENCIES_UNREADY,
-                                            RESTARTING):
-                        if not self._schedule_actor(entry):
-                            self._queued_tasks.appendleft(spec)
-                            stuck_demands.append(entry.spec.resources)
-                            break  # this actor can't place now
+                    stuck = False
+                    with self._actor_lock:
+                        entry = self._actors.get(spec.actor_id.binary())
+                        if entry is not None and entry.node_id is None \
+                                and entry.state in (PENDING_CREATION,
+                                                    DEPENDENCIES_UNREADY,
+                                                    RESTARTING):
+                            if not self._schedule_actor(entry):
+                                self._queued_tasks.appendleft(spec)
+                                stuck_demands.append(entry.spec.resources)
+                                stuck = True
+                    if stuck:
+                        break  # this actor can't place now
                     continue
                 if spec.task_id.binary() in self._cancelled_tasks:
                     # e.g. a retry re-enqueued after a force-cancel: fail
@@ -979,27 +1197,29 @@ class GcsServer:
 
     def _h_task_done(self, conn, p, msg_id):
         """Node manager reports task completion (success or failure)."""
-        with self._lock:
+        with self._sched_lock:
             tid = p["task_id"]
             entry = self._running_tasks.pop(tid, None)
             if entry is not None:
                 spec, node_id = entry
                 self._release_for(spec, node_id)
-            pinned_spec = self._actor_task_pins.pop(tid, None)
-            if pinned_spec is not None:
-                self._unpin_task_args(pinned_spec)
-            for oid, size in p.get("objects", []):
-                self._add_location(oid, p["node_id"], size)
-            if entry is not None and \
-                    getattr(entry[0], "num_returns", None) == "dynamic":
-                # Dynamic yields are reconstructable: re-running the
-                # generator re-stores every index idempotently.
-                for oid, _size in p.get("objects", []):
-                    self._producing_task[oid] = tid
-            if p["status"] == "crashed" and entry is not None:
-                self._handle_task_failure(entry[0], p.get("error", "worker died"))
-            elif entry is not None:
-                self._unpin_task_args(entry[0])
+            with self._obj_lock:
+                pinned_spec = self._actor_task_pins.pop(tid, None)
+                if pinned_spec is not None:
+                    self._unpin_task_args(pinned_spec)
+                for oid, size in p.get("objects", []):
+                    self._add_location(oid, p["node_id"], size)
+                if entry is not None and \
+                        getattr(entry[0], "num_returns", None) == "dynamic":
+                    # Dynamic yields are reconstructable: re-running the
+                    # generator re-stores every index idempotently.
+                    for oid, _size in p.get("objects", []):
+                        self._producing_task[oid] = tid
+                if p["status"] == "crashed" and entry is not None:
+                    self._handle_task_failure(entry[0],
+                                              p.get("error", "worker died"))
+                elif entry is not None:
+                    self._unpin_task_args(entry[0])
             self._try_schedule()
 
     # ------------------------------------------------- worker leases
@@ -1016,10 +1236,12 @@ class GcsServer:
                 continue
             head = q[0]
             if isinstance(head, _ActorCreationShim):
-                entry = self._actors.get(head.actor_id.binary())
-                if entry is None:
+                with self._actor_lock:
+                    entry = self._actors.get(head.actor_id.binary())
+                    demand = entry.spec.resources \
+                        if entry is not None else None
+                if demand is None:
                     continue
-                demand = entry.spec.resources
             else:
                 demand = head.resources
             if not self._demand_overlaps(demand, resources):
@@ -1037,7 +1259,7 @@ class GcsServer:
         """
         import os as _os
 
-        with self._lock:
+        with self._sched_lock:
             resources = p["resources"]
             # Fairness: while classic-path work (tasks, actor creations)
             # that COMPETES for these resources is queued, leases may not
@@ -1072,7 +1294,7 @@ class GcsServer:
             })
 
     def _h_return_lease(self, conn, p, msg_id):
-        with self._lock:
+        with self._sched_lock:
             self._release_lease_locked(p["lease_id"])
             self._try_schedule()
 
@@ -1108,9 +1330,15 @@ class GcsServer:
         """Batched completion report for lease-path tasks: registers
         object locations (so other clients' get/wait resolve) and retains
         specs for lineage — the deferred, amortized equivalent of what
-        submit_task + task_done do synchronously on the classic path."""
+        submit_task + task_done do synchronously on the classic path.
+
+        Object-shard only on the common path: the scheduling shard is
+        touched (two-phase, after obj releases) only when a location
+        unblocked dep-parked tasks — lease completions never contend
+        with placement otherwise."""
         node_id = p["node_id"]
-        with self._lock:
+        woken: List[Any] = []
+        with self._obj_lock:
             for t in p["tasks"]:
                 spec = t.get("spec")
                 if spec is not None:
@@ -1121,12 +1349,17 @@ class GcsServer:
                         spec.retries_left = spec.max_retries
                     self._retain_spec_locked(spec)
                 for oid, size in t.get("objects", ()):
-                    self._add_location(oid, node_id, size)
+                    woken.extend(self._add_location_obj(oid, node_id, size))
                 if spec is not None and \
                         getattr(spec, "num_returns", None) == "dynamic":
                     for oid, _size in t.get("objects", ()):
                         self._producing_task[oid] = \
                             spec.task_id.binary()
+        if woken:
+            with self._sched_lock:
+                for spec in woken:
+                    self._enqueue_task(spec)
+                self._try_schedule()
 
     def _handle_task_failure(self, spec: TaskSpec, reason: str):
         """System failure (worker/node death): retry or store error objects."""
@@ -1139,14 +1372,18 @@ class GcsServer:
             self._fail_task_objects(spec, reason)
 
     def _fail_task_objects(self, spec, reason: str):
-        """Ask the owner's node to materialize error objects for the returns."""
-        self._unpin_task_args(spec)
-        self._actor_task_pins.pop(spec.task_id.binary(), None)
-        owner_node = self._nodes.get(getattr(spec, "owner_node", None)) or next(
-            (n for n in self._nodes.values() if n.alive), None)
+        """Ask the owner's node to materialize error objects for the
+        returns. Acquires _obj_lock itself (reentrant under callers that
+        hold it); callable from any shard at rank <= obj. Node lookup is
+        a routing read."""
         ids = [r.binary() for r in spec.return_ids()]
-        for oid in ids:
-            self._failed_objects[oid] = reason
+        with self._obj_lock:
+            self._unpin_task_args(spec)
+            self._actor_task_pins.pop(spec.task_id.binary(), None)
+            for oid in ids:
+                self._failed_objects[oid] = reason
+        owner_node = self._nodes.get(getattr(spec, "owner_node", None)) \
+            or next((n for n in list(self._nodes.values()) if n.alive), None)
         if owner_node is not None:
             try:
                 owner_node.conn.notify("store_error_objects", {
@@ -1160,7 +1397,7 @@ class GcsServer:
 
     def _h_cancel_task(self, conn, p, msg_id):
         tid = p["task_id"]
-        with self._lock:
+        with self._sched_lock, self._obj_lock:
             self._cancelled_tasks.add(tid)
             # Capture the spec BEFORE removing it from the queues — the
             # not-running branch below must fail its return objects, and
@@ -1198,18 +1435,26 @@ class GcsServer:
     # ------------------------------------------------------------- objects
 
     def _add_location(self, oid: bytes, node_id: str, size: int = 0):
+        """Register a copy and wake dep-parked tasks inline. Caller holds
+        _sched_lock AND _obj_lock; callers holding only _obj_lock use
+        _add_location_obj and enqueue the returned specs under
+        _sched_lock after releasing obj (two-phase — never acquire
+        rank-backward)."""
+        for spec in self._add_location_obj(oid, node_id, size):
+            self._enqueue_task(spec)
+
+    def _add_location_obj(self, oid: bytes, node_id: str,
+                          size: int = 0) -> List[Any]:
+        """Object-shard half: directory entry, waiter fulfillment;
+        returns the dep-parked specs this copy unblocked (some may still
+        wait on other deps — _enqueue_task re-parks those). Caller holds
+        _obj_lock."""
         self._obj_locations[oid].add(node_id)
         if size:
             self._obj_sizes[oid] = size
-        # wake tasks waiting on this dep
-        waiting = self._waiting_tasks.pop(oid, None)
-        if waiting:
-            for spec in waiting:
-                if not self._unready_deps(spec.arg_deps):
-                    self._queued_tasks.append(spec)
-                else:
-                    self._enqueue_task(spec)
+        woken = self._waiting_tasks.pop(oid, None) or []
         self._fulfill_obj_waiters(oid, failed=False)
+        return woken
 
     def _fulfill_obj_waiters(self, oid: bytes, failed: bool):
         done = []
@@ -1232,19 +1477,22 @@ class GcsServer:
                 pass
 
     def _h_add_object_locations(self, conn, p, msg_id):
-        with self._lock:
-            for oid, size in p["objects"]:
-                self._add_location(oid, p["node_id"], size)
+        with self._sched_lock:
+            with self._obj_lock:
+                for oid, size in p["objects"]:
+                    self._add_location(oid, p["node_id"], size)
             self._try_schedule()
 
     def _h_remove_object_location(self, conn, p, msg_id):
-        with self._lock:
+        with self._obj_lock:
             locs = self._obj_locations.get(p["object_id"])
             if locs is not None:
                 locs.discard(p["node_id"])
 
     def _h_object_locations(self, conn, p, msg_id):
-        with self._lock:
+        # Node entries resolve via routing reads; only the directory
+        # needs the object shard.
+        with self._obj_lock:
             out = {}
             for oid in p["object_ids"]:
                 nodes = [self._nodes[n] for n in self._obj_locations.get(oid, ())
@@ -1257,50 +1505,60 @@ class GcsServer:
             conn.reply(msg_id, out)
 
     def _h_wait_for_objects(self, conn, p, msg_id):
-        """Park until num_returns of object_ids are ready (or failed/timeout)."""
-        with self._lock:
-            ids: List[bytes] = p["object_ids"]
-            ready = {o for o in ids if self._obj_locations.get(o)}
-            failed = {o for o in ids if o in self._failed_objects} - ready
-            need = p.get("num_returns", len(ids))
-            if len(ready) + len(failed) >= need:
-                conn.reply(msg_id, {
-                    "ready": list(ready),
-                    "failed": {o: self._failed_objects.get(o, "failed")
-                               for o in failed},
-                    "timeout": False,
-                })
-                return
-            timeout = p.get("timeout")
-            w = _ObjWaiter(
-                conn=conn, msg_id=msg_id,
-                pending=set(ids) - ready - failed,
-                num_needed=need, ready=ready, failed=failed,
-                deadline=(time.time() + timeout) if timeout is not None else None,
-            )
-            self._obj_waiters.append(w)
-            # Produced-then-lost objects (location set exists but is empty:
-            # every copy died) get lineage reconstruction. Never-produced
-            # objects are simply not ready yet — their producer (task or
-            # actor call) is still in flight.
-            kicked = False
-            for o in list(w.pending):
-                if o in self._obj_locations and not self._obj_locations[o]:
-                    self._try_reconstruct(o)
-                    kicked = True
+        """Park until num_returns of object_ids are ready (or
+        failed/timeout). Takes sched+obj: lost objects found here kick
+        lineage reconstruction, which enqueues onto the task queues; the
+        scheduler pass itself runs after obj releases (it nests the
+        actor shard, which ranks below obj)."""
+        with self._sched_lock:
+            with self._obj_lock:
+                ids: List[bytes] = p["object_ids"]
+                ready = {o for o in ids if self._obj_locations.get(o)}
+                failed = {o for o in ids
+                          if o in self._failed_objects} - ready
+                need = p.get("num_returns", len(ids))
+                if len(ready) + len(failed) >= need:
+                    conn.reply(msg_id, {
+                        "ready": list(ready),
+                        "failed": {o: self._failed_objects.get(o, "failed")
+                                   for o in failed},
+                        "timeout": False,
+                    })
+                    return
+                timeout = p.get("timeout")
+                w = _ObjWaiter(
+                    conn=conn, msg_id=msg_id,
+                    pending=set(ids) - ready - failed,
+                    num_needed=need, ready=ready, failed=failed,
+                    deadline=(time.time() + timeout)
+                    if timeout is not None else None,
+                )
+                self._obj_waiters.append(w)
+                # Produced-then-lost objects (location set exists but is
+                # empty: every copy died) get lineage reconstruction.
+                # Never-produced objects are simply not ready yet — their
+                # producer (task or actor call) is still in flight.
+                kicked = False
+                for o in list(w.pending):
+                    if o in self._obj_locations                             and not self._obj_locations[o]:
+                        self._try_reconstruct(o)
+                        kicked = True
             if kicked:
                 self._try_schedule()
 
     def _h_free_objects(self, conn, p, msg_id):
-        with self._lock:
-            self._free_now(p["object_ids"])
+        with self._obj_lock:
+            deletes = self._free_now(p["object_ids"])
+        self._send_deletes(deletes)
         conn.reply(msg_id, True)
 
-    def _free_now(self, ids: List[bytes]):
+    def _free_now(self, ids: List[bytes]) -> Dict[str, List[bytes]]:
         """Drop an object cluster-wide: directory entry, node copies, and —
         once every return of the producing task is gone — its lineage spec.
-        Called with the lock held (explicit ``free`` and the zero-ref
-        deferred-free timer both land here)."""
+        Called with _obj_lock held (explicit ``free`` and the zero-ref
+        deferred-free timer both land here). Returns the per-node delete
+        map; the caller sends the delete notifications AFTER releasing
+        the lock (_send_deletes)."""
         by_node: Dict[str, List[bytes]] = collections.defaultdict(list)
         for oid in ids:
             for nid in self._obj_locations.pop(oid, ()):  # noqa: B909
@@ -1314,19 +1572,29 @@ class GcsServer:
             # Lineage (_producing_task/_task_specs) is deliberately kept:
             # a freed object may still be an input of a downstream task's
             # reconstruction; the spec table is bounded by tasks submitted.
+        return by_node
+
+    def _send_deletes(self, by_node: Dict[str, List[bytes]]) -> None:
+        """Ship delete_objects notifications collected by _free_now.
+        Runs outside every shard lock; node lookup is a routing read."""
         for nid, oids in by_node.items():
             node = self._nodes.get(nid)
             if node is not None and node.alive:
-                node.conn.notify("delete_objects", {"object_ids": oids})
+                try:
+                    node.conn.notify("delete_objects",
+                                     {"object_ids": oids})
+                except Exception:
+                    pass
 
     # ------------------------------------------------------ ref counting
 
     def _h_update_refcounts(self, conn, p, msg_id):
         """Batched ref-count deltas from one client (reference role:
         core_worker/reference_count.h:61 owner tables + borrower
-        registration, aggregated at the GCS here)."""
+        registration, aggregated at the GCS here). Object shard only —
+        refcount churn never contends with scheduling."""
         cid = p["client_id"]
-        with self._lock:
+        with self._obj_lock:
             for oid, delta in p["deltas"].items():
                 counts = self._refcounts.setdefault(oid, {})
                 if delta:
@@ -1456,7 +1724,14 @@ class GcsServer:
     # -------------------------------------------------------------- actors
 
     def _h_create_actor(self, conn, spec: ActorCreationSpec, msg_id):
-        with self._lock:
+        # Placement mutates the node ledger: sched+actor, rank order.
+        with self._sched_lock, self._actor_lock:
+            existing_entry = self._actors.get(spec.actor_id.binary())
+            if existing_entry is not None and existing_entry.state != DEAD:
+                # Duplicate create (driver NM-death recovery racing a
+                # late actor_placed): first registration wins.
+                conn.reply(msg_id, {"ok": True, "existing": True})
+                return
             if spec.name:
                 key = (spec.namespace, spec.name)
                 existing = self._named_actors.get(key)
@@ -1475,14 +1750,19 @@ class GcsServer:
 
     def _schedule_actor(self, entry: ActorEntry) -> bool:
         """Try to place the actor now. Returns True if dispatched (or parked
-        on unready dependencies); False if it must wait for capacity."""
+        on unready dependencies); False if it must wait for capacity.
+        Caller holds _sched_lock + _actor_lock; obj nests forward for
+        the dependency check."""
         spec = entry.spec
-        if self._unready_deps(spec.arg_deps):
-            entry.state = DEPENDENCIES_UNREADY
-            # Park on the first unready dep; re-enqueued via _add_location.
-            d = self._unready_deps(spec.arg_deps)[0]
-            self._waiting_tasks[d.binary()].append(_ActorCreationShim(entry))
-            return True
+        with self._obj_lock:
+            unready = self._unready_deps(spec.arg_deps)
+            if unready:
+                entry.state = DEPENDENCIES_UNREADY
+                # Park on the first unready dep; re-enqueued via
+                # _add_location.
+                self._waiting_tasks[unready[0].binary()].append(
+                    _ActorCreationShim(entry))
+                return True
         if spec.placement_group_id is not None:
             pg = self._pgs.get(spec.placement_group_id.binary())
             node = None
@@ -1496,12 +1776,44 @@ class GcsServer:
             return False
         entry.state = PENDING_CREATION
         entry.node_id = node.node_id
+        entry.local_placement = False   # centrally acquired from here on
         node.conn.notify("create_actor", spec)
         return True
 
+    def _h_actor_placed(self, conn, p, msg_id):
+        """A node manager placed an actor from its OWN ledger
+        (decentralized creation). Register the directory entry the NM's
+        later lifecycle reports will update — the NM sends this on the
+        same conn BEFORE any actor_state for the actor, so the entry
+        always exists by the time ALIVE/DEAD arrives. Resources are NOT
+        acquired centrally: they ride the node's local_held aggregate."""
+        spec = p["spec"]
+        aid = spec.actor_id.binary()
+        with self._sched_lock, self._actor_lock:
+            node = self._nodes.get(p["node_id"])
+            if node is not None and "local_held" in p:
+                # The report doubles as an eager resource report (same
+                # seq-versioned merge rule as heartbeats).
+                self._merge_local_held(node, p)
+            if aid in self._actors and self._actors[aid].state != DEAD:
+                return   # duplicate (driver recovery raced the report)
+            entry = ActorEntry(spec=spec, state=PENDING_CREATION,
+                               node_id=p["node_id"],
+                               restarts_left=spec.max_restarts,
+                               local_placement=True)
+            self._actors[aid] = entry
+            if spec.name:
+                self._named_actors.setdefault(
+                    (spec.namespace, spec.name), aid)
+            self._persist_actor(aid)
+            if self._killed_before_placed.pop(aid, None) is not None:
+                # ray.kill beat the placement report here: finish it.
+                self._kill_actor_locked(
+                    aid, True, "ray.kill (before placement report)")
+
     def _h_actor_state(self, conn, p, msg_id):
         """Node manager reports actor lifecycle transitions."""
-        with self._lock:
+        with self._sched_lock, self._actor_lock:
             aid = p["actor_id"]
             entry = self._actors.get(aid)
             if entry is None:
@@ -1519,7 +1831,8 @@ class GcsServer:
                     # __init__ raised: actor is permanently dead
                     entry.state = DEAD
                     entry.death_cause = p.get("error", "creation failed")
-                    if entry.node_id:
+                    if entry.node_id and not entry.local_placement:
+                        # (NM-placed: the node's own ledger releases.)
                         self._release_for(entry.spec, entry.node_id)
                     self._reply_actor_waiters(entry)
                 else:
@@ -1528,12 +1841,15 @@ class GcsServer:
             self._try_schedule()
 
     def _on_actor_down(self, aid: bytes, cause: str, expected: bool = False):
+        # Caller holds _sched_lock + _actor_lock.
         entry = self._actors.get(aid)
         if entry is None or entry.state == DEAD:
             return
         if entry.node_id:
-            self._release_for(entry.spec, entry.node_id)
+            if not entry.local_placement:
+                self._release_for(entry.spec, entry.node_id)
             entry.node_id = None
+            entry.local_placement = False
         if not expected and entry.restarts_left != 0:
             if entry.restarts_left > 0:
                 entry.restarts_left -= 1
@@ -1582,7 +1898,7 @@ class GcsServer:
         The spec's args are pinned here (the rerouting caller released
         its pin) until the task completes — _h_task_done unpins via
         _actor_task_pins — or fails (_fail_task_objects unpins)."""
-        with self._lock:
+        with self._actor_lock, self._obj_lock:
             entry = self._actors.get(spec.actor_id.binary())
             if entry is None or entry.state == DEAD:
                 cause = entry.death_cause if entry else "actor not found"
@@ -1616,7 +1932,7 @@ class GcsServer:
 
     def _h_resolve_actor(self, conn, p, msg_id):
         """Reply with the actor's location; parks while PENDING/RESTARTING."""
-        with self._lock:
+        with self._actor_lock:
             entry = self._actors.get(p["actor_id"])
             if entry is None:
                 conn.reply_error(msg_id, "actor not found")
@@ -1627,7 +1943,7 @@ class GcsServer:
                 entry.waiters.append((conn, msg_id))
 
     def _h_get_actor_by_name(self, conn, p, msg_id):
-        with self._lock:
+        with self._actor_lock:
             aid = self._named_actors.get((p.get("namespace", "default"),
                                           p["name"]))
             entry = self._actors.get(aid) if aid else None
@@ -1637,7 +1953,7 @@ class GcsServer:
                 conn.reply(msg_id, self._actor_info(entry))
 
     def _h_list_named_actors(self, conn, p, msg_id):
-        with self._lock:
+        with self._actor_lock:
             out = []
             for (ns, name), aid in self._named_actors.items():
                 e = self._actors.get(aid)
@@ -1648,12 +1964,23 @@ class GcsServer:
             conn.reply(msg_id, out)
 
     def _h_kill_actor(self, conn, p, msg_id):
-        with self._lock:
-            self._kill_actor_locked(p["actor_id"], p.get("no_restart", True),
+        # Kill may restart-or-bury the actor (_on_actor_down releases
+        # node resources / re-places): sched+actor in rank order.
+        with self._sched_lock, self._actor_lock:
+            aid = p["actor_id"]
+            if aid not in self._actors and p.get("no_restart", True):
+                # Decentralized-creation race: the kill can overtake the
+                # NM's actor_placed report. Tombstone it — actor_placed
+                # completes the kill on arrival (bounded FIFO).
+                self._killed_before_placed[aid] = time.time()
+                while len(self._killed_before_placed) > 1024:
+                    self._killed_before_placed.popitem(last=False)
+            self._kill_actor_locked(aid, p.get("no_restart", True),
                                     "ray.kill")
         conn.reply(msg_id, True)
 
     def _kill_actor_locked(self, aid: bytes, no_restart: bool, cause: str):
+        # Caller holds _sched_lock + _actor_lock.
         entry = self._actors.get(aid)
         if entry is None or entry.state == DEAD:
             return
@@ -1667,14 +1994,15 @@ class GcsServer:
             self._on_actor_down(aid, cause, expected=no_restart)
 
     def _h_list_actors(self, conn, p, msg_id):
-        with self._lock:
+        with self._actor_lock:
             conn.reply(msg_id, [self._actor_info(e)
                                 for e in self._actors.values()])
 
     # ----------------------------------------------------- placement groups
 
     def _h_create_pg(self, conn, spec: PlacementGroupSpec, msg_id):
-        with self._lock:
+        # Bundle placement reserves node resources: sched+actor.
+        with self._sched_lock, self._actor_lock:
             if spec.name:
                 if spec.name in self._named_pgs:
                     conn.reply_error(msg_id,
@@ -1689,7 +2017,8 @@ class GcsServer:
     def _try_place_pg(self, entry: PgEntry) -> bool:
         """Bundle placement (reference:
         raylet/scheduling/policy/bundle_scheduling_policy.h:31). All-or-
-        nothing: trial-reserve, commit on success."""
+        nothing: trial-reserve, commit on success. Caller holds
+        _sched_lock + _actor_lock (node ledger + PG tables)."""
         spec = entry.spec
         alive = [n for n in self._nodes.values() if n.alive]
         if not alive:
@@ -1798,12 +2127,13 @@ class GcsServer:
         return True
 
     def _try_schedule_pgs(self):
+        # Caller holds _sched_lock + _actor_lock.
         for entry in self._pgs.values():
             if entry.state == "PENDING":
                 self._try_place_pg(entry)
 
     def _h_wait_pg_ready(self, conn, p, msg_id):
-        with self._lock:
+        with self._actor_lock:
             entry = self._pgs.get(p["pg_id"])
             if entry is None:
                 conn.reply_error(msg_id, "placement group not found")
@@ -1813,7 +2143,8 @@ class GcsServer:
                 entry.waiters.append((conn, msg_id))
 
     def _h_remove_pg(self, conn, p, msg_id):
-        with self._lock:
+        # Returns bundle capacity to the node ledger: sched+actor.
+        with self._sched_lock, self._actor_lock:
             entry = self._pgs.get(p["pg_id"])
             if entry is not None and entry.state == "CREATED":
                 # return bundle capacity to nodes
@@ -1830,7 +2161,7 @@ class GcsServer:
         conn.reply(msg_id, True)
 
     def _h_pg_table(self, conn, p, msg_id):
-        with self._lock:
+        with self._actor_lock:
             out = {}
             for pid, e in self._pgs.items():
                 out[pid] = {
@@ -1847,7 +2178,7 @@ class GcsServer:
         """Fan a stack-dump request out to every node (reference: the
         `ray stack` CLI, scripts.py; dumps surface via the log stream).
         Legacy SIGUSR2 path; the in-band data path is collect_stacks."""
-        with self._lock:
+        with self._sched_lock:
             nodes = [n for n in self._nodes.values() if n.alive]
         for n in nodes:
             try:
@@ -1861,7 +2192,7 @@ class GcsServer:
     # here the GCS holds the node conns, so it IS the fan-in hop)
 
     def _agent_nodes(self, node_filter: Optional[str]):
-        with self._lock:
+        with self._sched_lock:
             return [(n.node_id, n.conn) for n in self._nodes.values()
                     if n.alive and (not node_filter
                                     or n.node_id.startswith(node_filter))]
@@ -1907,7 +2238,7 @@ class GcsServer:
         nodes = self._agent_nodes(p.pop("node_id", None))
         aid = p.get("actor_id")
         if aid:
-            with self._lock:
+            with self._actor_lock:
                 homes = {e.node_id for a, e in self._actors.items()
                          if a.hex().startswith(aid) and e.node_id}
             if homes:
@@ -1934,12 +2265,12 @@ class GcsServer:
         """Subscribe this connection to a channel (reference:
         src/ray/pubsub/publisher.h GcsPublisher channels — actor state,
         logs, errors; here one generic channel table)."""
-        with self._lock:
+        with self._kv_lock:
             conn.meta.setdefault("subscriptions", set()).add(p["channel"])
         conn.reply(msg_id, True)
 
     def _h_unsubscribe(self, conn, p, msg_id):
-        with self._lock:
+        with self._kv_lock:
             conn.meta.setdefault("subscriptions", set()).discard(
                 p["channel"])
         conn.reply(msg_id, True)
@@ -1948,19 +2279,15 @@ class GcsServer:
         self._publish(p["channel"], p["message"])
 
     def _publish(self, channel: str, message):
-        """Push to every subscriber; dead conns are skipped (their
-        subscriptions die with the connection)."""
-        with self._lock:
-            targets = [c for c in self._clients.values()
-                       if channel in c.meta.get("subscriptions", ())]
-            targets += [n.conn for n in self._nodes.values()
-                        if n.alive and channel in
-                        n.conn.meta.get("subscriptions", ())]
-        for c in targets:
-            try:
-                c.notify("pubsub", {"channel": channel, "message": message})
-            except Exception:
-                pass
+        """Record-then-publish: enqueue on the outbox and wake the
+        publisher thread, which snapshots the subscriber set and sends
+        OUTSIDE every shard lock — lifecycle paths (actor death, node
+        death) can publish from under their locks without a slow
+        subscriber socket stalling the control plane. Dead conns are
+        skipped at send time (their subscriptions die with the
+        connection)."""
+        self._pub_q.append((channel, message))
+        self._pub_ev.set()
 
     # ----------------------------------------------------------- worker logs
 
@@ -1968,7 +2295,7 @@ class GcsServer:
         """Fan worker log lines out to drivers that registered with
         log_to_driver (reference: log_monitor publishing via GCS pubsub,
         _private/log_monitor.py:104)."""
-        with self._lock:
+        with self._sched_lock:
             targets = [c for c in self._clients.values()
                        if c.meta.get("log_to_driver")]
         for c in targets:
@@ -1980,7 +2307,7 @@ class GcsServer:
     # ------------------------------------------------------- task events
 
     def _h_task_events(self, conn, p, msg_id):
-        with self._lock:
+        with self._kv_lock:
             self._task_events.extend(p)
 
     # ------------------------------------------------- state API (reference:
@@ -1989,7 +2316,8 @@ class GcsServer:
 
     def _h_list_tasks(self, conn, p, msg_id):
         limit = (p or {}).get("limit", 1000)
-        with self._lock:
+        # State-API read spanning three shards: canonical rank order.
+        with self._sched_lock, self._obj_lock, self._kv_lock:
             out = []
             for tid, (spec, node_id) in self._running_tasks.items():
                 out.append({"task_id": tid.hex(),
@@ -2026,7 +2354,7 @@ class GcsServer:
 
     def _h_list_objects(self, conn, p, msg_id):
         limit = (p or {}).get("limit", 1000)
-        with self._lock:
+        with self._obj_lock:
             out = []
             for oid, nodes in itertools.islice(
                     self._obj_locations.items(), limit):
@@ -2042,14 +2370,14 @@ class GcsServer:
             conn.reply(msg_id, out)
 
     def _h_list_jobs(self, conn, p, msg_id):
-        with self._lock:
+        with self._sched_lock:
             conn.reply(msg_id, list(self._jobs.values()))
 
     def _h_object_spilled(self, conn, p, msg_id):
         """A node spilled an object to its disk; the node keeps serving it
         (restore-on-fetch), so its location entry stays (reference:
         spilled-URL tracking in the ownership directory)."""
-        with self._lock:
+        with self._obj_lock:
             self._spilled_objects[p["object_id"]] = {
                 "node_id": p["node_id"], "url": p["url"]}
             self._obj_locations[p["object_id"]].add(p["node_id"])
@@ -2058,7 +2386,7 @@ class GcsServer:
         """Store a process's latest metric samples (reference: per-node
         MetricsAgent aggregation, _private/metrics_agent.py:375)."""
         stale_cutoff = time.time() - 300
-        with self._lock:
+        with self._kv_lock:
             self._metrics[p["client_id"]] = {
                 "samples": p["samples"], "ts": p["ts"],
                 "period_s": p.get("period_s")}
@@ -2073,7 +2401,8 @@ class GcsServer:
         (worker death / replica downscale) — a killed LLM replica's
         gauges must not report stale queue depths forever."""
         now = time.time()
-        with self._lock:
+        # _clients membership is a routing read; the table is kv-shard.
+        with self._kv_lock:
             groups = []
             for cid, m in list(self._metrics.items()):
                 period = float(m.get("period_s") or 5.0)
@@ -2084,12 +2413,37 @@ class GcsServer:
                 groups.append(m["samples"])
             conn.reply(msg_id, groups)
 
+    def _h_control_plane_stats(self, conn, p, msg_id):
+        """O(1) per-shard backlog gauges (bench drain barriers, CLI
+        debugging) — the cheap counterpart of the O(queue)
+        pending_demand payload. Shards are read sequentially, never
+        nested."""
+        out = {}
+        with self._sched_lock:
+            out["queued_tasks"] = len(self._queued_tasks)
+            out["running_tasks"] = len(self._running_tasks)
+            out["leases"] = len(self._leases)
+            out["nodes_alive"] = sum(1 for n in self._nodes.values()
+                                     if n.alive)
+        with self._actor_lock:
+            out["actors"] = len(self._actors)
+            out["actors_pending"] = sum(
+                1 for e in self._actors.values()
+                if e.state in (PENDING_CREATION, RESTARTING))
+        with self._obj_lock:
+            out["obj_waiters"] = len(self._obj_waiters)
+            out["pending_free"] = len(self._pending_free)
+            out["tracked_objects"] = len(self._obj_locations)
+        with self._kv_lock:
+            out["publish_outbox"] = len(self._pub_q)
+        conn.reply(msg_id, out)
+
     def _h_pending_demand(self, conn, p, msg_id):
         """Unplaceable resource demand, for the autoscaler (reference:
         LoadMetrics fed from GCS resource reports —
         autoscaler/_private/load_metrics.py; demand =
         resource_demand_scheduler.py:171 input)."""
-        with self._lock:
+        with self._sched_lock, self._actor_lock:
             demand: List[Dict[str, float]] = []
             for spec in self._queued_tasks:
                 r = getattr(spec, "resources", None)
@@ -2115,7 +2469,7 @@ class GcsServer:
             conn.reply(msg_id, {"tasks": demand, "pg_bundles": pg_demand})
 
     def _h_summarize_tasks(self, conn, p, msg_id):
-        with self._lock:
+        with self._sched_lock, self._kv_lock:
             by_name: Dict[str, Dict[str, int]] = {}
             for ev in self._task_events:
                 if ev.get("kind") not in ("task", "actor_task"):
@@ -2132,7 +2486,7 @@ class GcsServer:
             conn.reply(msg_id, by_name)
 
     def _h_get_timeline(self, conn, p, msg_id):
-        with self._lock:
+        with self._kv_lock:
             conn.reply(msg_id, list(self._task_events))
 
     # ------------------------------------------------------------ shutdown
@@ -2156,6 +2510,36 @@ class _ActorCreationShim:
         self.task_id = TaskID.for_actor_creation(entry.spec.actor_id)
         self.arg_deps = entry.spec.arg_deps
         self.placement_group_id = None
+
+
+# Shard observability metrics (lazy: the metrics module starts a
+# reporter thread; only build them once the GCS timer first samples).
+_shard_metric_cache = None
+_shard_metric_lock = threading.Lock()
+
+
+def _shard_metrics():
+    global _shard_metric_cache
+    if _shard_metric_cache is None:
+        with _shard_metric_lock:
+            if _shard_metric_cache is None:
+                from ray_tpu.util import metrics
+
+                wait_h = metrics.Histogram(
+                    "gcs_shard_lock_wait_seconds",
+                    "Sampled GCS shard-lock acquire wait (timer probe)",
+                    boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                                0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                                0.5, 1.0],
+                    tag_keys=("shard",))
+                depth_g = metrics.Gauge(
+                    "gcs_shard_queue_depth",
+                    "Per-domain GCS backlog (queued tasks / pending "
+                    "actors / parked waiters+frees / publish outbox)",
+                    tag_keys=("shard",))
+                metrics.start_reporter()
+                _shard_metric_cache = (wait_h, depth_g)
+    return _shard_metric_cache
 
 
 def p_kind(spec) -> str:

@@ -23,7 +23,7 @@ import threading
 # Entropy for ID minting is drawn from a refilled buffer: one urandom
 # syscall per ~512 IDs instead of per ID (ID creation is on the task
 # submission hot path — reference ids are likewise cheap random bytes).
-_ENTROPY_CHUNK = 8192
+_ENTROPY_CHUNK = 65536
 _entropy = os.urandom(_ENTROPY_CHUNK)
 _entropy_off = 0
 _entropy_lock = threading.Lock()
@@ -75,7 +75,10 @@ class BaseID:
                 f"got {len(id_bytes)}"
             )
         self._bytes = bytes(id_bytes)
-        self._hash = hash(self._bytes)
+        # Hash lazily: id minting is on the task-submission hot path and
+        # most ids (return ids in flight, parsed peers) are never used
+        # as dict keys in this process.
+        self._hash = None
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -99,7 +102,10 @@ class BaseID:
         return self._bytes.hex()
 
     def __hash__(self) -> int:
-        return self._hash
+        h = self._hash
+        if h is None:
+            h = self._hash = hash(self._bytes)
+        return h
 
     def __eq__(self, other) -> bool:
         return type(other) is type(self) and other._bytes == self._bytes
@@ -142,10 +148,18 @@ class ActorID(BaseID):
     def of(cls, job_id: JobID) -> "ActorID":
         return cls(_rand_bytes(_ACTOR_UNIQUE_BYTES) + job_id.binary())
 
+    _nil_cache: dict = {}
+
     @classmethod
     def nil_for_job(cls, job_id: JobID) -> "ActorID":
-        """The placeholder actor id embedded in non-actor task ids."""
-        return cls(b"\xff" * _ACTOR_UNIQUE_BYTES + job_id.binary())
+        """The placeholder actor id embedded in non-actor task ids
+        (cached per job: this runs once per task submission)."""
+        key = job_id.binary()
+        cached = cls._nil_cache.get(key)
+        if cached is None:
+            cached = cls._nil_cache[key] = cls(
+                b"\xff" * _ACTOR_UNIQUE_BYTES + key)
+        return cached
 
     def job_id(self) -> JobID:
         return JobID(self._bytes[_ACTOR_UNIQUE_BYTES:])

@@ -105,6 +105,14 @@ class LeaseManager:
         self._w = worker
         self._lock = threading.Lock()
         self._shapes: Dict[tuple, _ShapeState] = {}
+        # id(resources dict) -> (dict ref, sorted shape key); see submit.
+        self._shape_keys: Dict[int, Tuple[Dict[str, float], tuple]] = {}
+        # Burst coalescing: reserved-but-unsent specs per lease. A burst
+        # of submits to a busy lease batches into one notify (flushed at
+        # _SEND_BATCH, on completions, on get()/wait() entry via
+        # flush_sends, and by the flush loop); the FIRST task on an idle
+        # lease always ships immediately so a lone submit never waits.
+        self._sendbuf: Dict[_Lease, List[Any]] = {}
         # oid bytes -> {"ev": Event, "info": (node_id, nm_addr, size)|None}
         self._inflight: Dict[bytes, Dict[str, Any]] = {}
         self._task_lease: Dict[bytes, Tuple[_Lease, Any]] = {}
@@ -165,7 +173,21 @@ class LeaseManager:
         must use the scheduled path)."""
         if self._closed:
             return False
-        key = tuple(sorted(spec.resources.items()))
+        # Shape-key memo: RemoteFunction shares ONE normalized resources
+        # dict across its submissions, so the sorted-tuple key can be
+        # cached by identity (the strong ref pins the dict, making the
+        # id stable; one entry per remote function).
+        res = spec.resources
+        ent = self._shape_keys.get(id(res))
+        if ent is not None and ent[0] is res:
+            key = ent[1]
+        else:
+            key = tuple(sorted(res.items()))
+            if len(self._shape_keys) >= 4096:
+                # Per-call .options() builds a fresh dict per submission;
+                # don't let the identity memo grow with it.
+                self._shape_keys.clear()
+            self._shape_keys[id(res)] = (res, key)
         with self._lock:
             if self._closed:
                 return False
@@ -179,8 +201,18 @@ class LeaseManager:
                 # queue: go classic now rather than strand the spec.
                 return False
             lease = self._pick_lease_locked(st)
+            batch = None
             if lease is not None:
                 self._reserve_locked(lease, spec)
+                if lease.inflight <= 1:
+                    # Worker is idle: ship now, plus anything buffered.
+                    batch = self._sendbuf.pop(lease, [])
+                    batch.append(spec)
+                else:
+                    buf = self._sendbuf.setdefault(lease, [])
+                    buf.append(spec)
+                    if len(buf) >= self._SEND_BATCH:
+                        batch = self._sendbuf.pop(lease)
             else:
                 st.queue.append(spec)
                 if (len(st.leases) + st.requesting < self._max_per_shape
@@ -193,9 +225,24 @@ class LeaseManager:
         # incref keeps the aggregate count positive until completion or
         # until the spec leaves for the classic path (which then pins).
         self._incref_deps(spec)
-        if lease is not None:
-            self._send(lease, [spec])
+        if batch:
+            self._send(lease, batch)
         return True
+
+    _SEND_BATCH = 16
+
+    def flush_sends(self) -> None:
+        """Ship every coalesced submit batch now. Called on get()/wait()
+        entry (a caller about to block must not sit on its own work),
+        from completions, and by the flush loop."""
+        with self._lock:
+            if not self._sendbuf:
+                return
+            pending = list(self._sendbuf.items())
+            self._sendbuf.clear()
+        for lease, specs in pending:
+            if specs and not lease.dead:
+                self._send(lease, specs)
 
     def _incref_deps(self, spec):
         refs = self._w._refs
@@ -545,6 +592,7 @@ class LeaseManager:
                                       "objects": rep["objects"]})
             st = self._shapes.get(lease.shape_key)
             if st is not None and not lease.dead:
+                drained.extend(self._sendbuf.pop(lease, ()))
                 while st.queue and lease.inflight < self._depth:
                     nxt = st.queue.popleft()
                     self._reserve_locked(lease, nxt)
@@ -655,6 +703,7 @@ class LeaseManager:
 
     def _drop_lease(self, lease: _Lease):
         with self._lock:
+            self._sendbuf.pop(lease, None)
             lease.dead = True
             st = self._shapes.get(lease.shape_key)
             if st is not None and lease in st.leases:
@@ -729,6 +778,10 @@ class LeaseManager:
                 return
             target.dead = True        # _pick_lease_locked skips it now
             target.draining = target.inflight > 0
+            # Reserved-but-coalesced specs count toward inflight: ship
+            # them now or the drain waits forever on work the worker
+            # never received.
+            buffered = self._sendbuf.pop(target, None)
             st = self._shapes.get(target.shape_key)
             # The GCS wants this capacity back for the classic queue:
             # queued (never-sent) specs go to the scheduled path instead
@@ -737,6 +790,8 @@ class LeaseManager:
                     and not any(not l.dead for l in st.leases):
                 while st.queue:
                     fallback_specs.append(st.queue.popleft())
+        if buffered:
+            self._send(target, buffered)
         self._fallback_many(fallback_specs)
         if not target.draining:
             self._exec_submit(self._drop_lease, target)
@@ -788,6 +843,7 @@ class LeaseManager:
     def _flush_loop(self):
         while not self._stop.wait(self._flush_s):
             try:
+                self.flush_sends()
                 self._flush_reports()
                 self._reap_idle()
                 self._retry_backlogged()

@@ -19,6 +19,7 @@ TPU-first deltas vs the reference raylet:
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
@@ -52,6 +53,36 @@ BUSY = "busy"
 STARTING = "starting"
 ACTOR = "actor"
 LEASED = "leased"   # checked out to a caller's direct task transport
+
+
+def _file_size(path: str) -> int:
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+class _SpawningProc:
+    """Placeholder proc for a WorkerHandle recorded before its process
+    exists (pre-fork registration): alive-but-starting to every
+    liveness check; kill/wait are no-ops (the real proc replaces this
+    within one spawn call)."""
+
+    pid = -1
+
+    def poll(self):
+        return None
+
+    def kill(self):
+        pass
+
+    terminate = kill
+
+    def wait(self, timeout=None):
+        return 0
+
+
+_SPAWNING = _SpawningProc()
 
 
 class _ForkedProc:
@@ -152,6 +183,8 @@ class NodeManager:
         os.makedirs(session_dir, exist_ok=True)
         self.store_path = os.path.join(
             session_dir, f"store_{self.node_id[:12]}")
+        self._log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(self._log_dir, exist_ok=True)
         plasma.create_store(self.store_path, object_store_memory)
         self.store = plasma.PlasmaClient(self.store_path)
 
@@ -205,6 +238,13 @@ class NodeManager:
         self._local_backoff_demands: List[Dict[str, float]] = []
         self.local_grants_total = 0
         self.local_spillbacks_total = 0
+        # Actors this NM placed from its OWN ledger (decentralized actor
+        # creation): their resources ride the local_held aggregate — the
+        # GCS never acquired them centrally — so the death/failure paths
+        # must subtract them from local_held too.
+        self._local_actor_ids: Set[bytes] = set()
+        self.local_actor_grants_total = 0
+        self.local_actor_spillbacks_total = 0
 
         # Per-node observability agent (reference: dashboard/agent.py —
         # the per-node DashboardAgent beside every raylet). Served over
@@ -213,6 +253,14 @@ class NodeManager:
 
         self.agent = NodeAgent(
             self, ring_size=int(config.flight_recorder_events))
+
+        # Decentralized actor creations run here, off conn serve threads
+        # (each one may fork a worker; bursts overlap instead of
+        # serializing behind the conn).
+        import concurrent.futures as _cf
+
+        self._actor_exec = _cf.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="rtpu-nm-actor")
 
         # Server for workers, remote pullers, and actor-task callers.
         self.server = protocol.Server(self._handle_server, name=f"nm-{node_name}")
@@ -271,11 +319,15 @@ class NodeManager:
         # worker_zygote.py; reference analog: prestart amortization,
         # worker_pool.h:344 — this removes the cost rather than hiding
         # it).
-        self._zygote: Optional[subprocess.Popen] = None
-        self._zygote_lock = threading.Lock()
-        self._zygote_io = None       # (socket, file) when connected
-        self._zygote_sock_path = ""
-        self._start_zygote()
+        # Zygote POOL: K independent fork-servers, each with its own
+        # socket conversation lock — worker spawns under an actor-churn
+        # or scale-out burst parallelize across them instead of
+        # convoying behind ONE ~10-30ms fork conversation (fork of a
+        # jax-preloaded image is page-table-bound; K forks on K cores
+        # multiply spawn throughput by K).
+        self._zygotes: List[dict] = []   # {proc, sock_path, io, lock}
+        self._zygote_rr = itertools.count()
+        self._start_zygotes()
 
         # Prestart the pool (reference: worker_pool.h:245 PrestartWorkers).
         for _ in range(self._max_pool):
@@ -321,13 +373,13 @@ class NodeManager:
                 w.proc.wait(timeout=5)
             except Exception:
                 pass
-        if self._zygote is not None:
+        for z in self._zygotes:
             try:
-                self._zygote.kill()
+                z["proc"].kill()
             except Exception:
                 pass
             try:
-                os.unlink(self._zygote_sock_path)
+                os.unlink(z["sock_path"])
             except OSError:
                 pass
         # The spiller and heartbeater touch the store (stats() reads the
@@ -339,6 +391,7 @@ class NodeManager:
         heartbeater = getattr(self, "_heartbeater", None)
         if heartbeater is not None:
             heartbeater.join(timeout=2)
+        self._actor_exec.shutdown(wait=False)
         self.server.close()
         try:
             self.gcs.close()
@@ -391,9 +444,7 @@ class NodeManager:
                                         "worker_id": wid.hex()[:12],
                                         "stream": stream, "lines": lines})
                 if dead and all(
-                        w.log_offsets.get(st, 0) >= (
-                            os.path.getsize(pa)
-                            if os.path.exists(pa) else 0)
+                        w.log_offsets.get(st, 0) >= _file_size(pa)
                         for st, pa in w.log_paths.items()):
                     self._log_watch.pop(wid, None)
             if entries:
@@ -668,6 +719,26 @@ class NodeManager:
                 continue
             conn.on_close = self._on_gcs_disconnect
             self.gcs = conn
+            # Re-send the placement report for every live locally-placed
+            # actor: an ACTOR_PLACED notify lost to the dying conn left
+            # the GCS permanently blind to it (register_node's actor
+            # re-report can only patch entries the GCS already has —
+            # it carries ids, not specs). Idempotent at the GCS.
+            with self._lock:
+                placed = [(aid, self._actors[aid].actor_spec)
+                          for aid in self._local_actor_ids
+                          if aid in self._actors
+                          and self._actors[aid].actor_spec is not None
+                          and self._actors[aid].proc.poll() is None]
+                held = self._local_held.to_dict()
+                held_seq = self._local_held_seq
+            for _aid, spec in placed:
+                try:
+                    conn.notify(protocol.ACTOR_PLACED, {
+                        "spec": spec, "node_id": self.node_id,
+                        "local_held": held, "local_held_seq": held_seq})
+                except Exception:
+                    break   # conn died again; the next rejoin re-sends
             logger.info("node %s rejoined gcs (%d actors, %d objects "
                         "re-reported)", self.node_id[:12], len(alive_actors),
                         len(objects))
@@ -744,9 +815,10 @@ class NodeManager:
 
     # ---------------------------------------------------------- worker pool
 
-    def _start_zygote(self) -> None:
+    def _start_zygotes(self) -> None:
         if not config.worker_zygote_enabled:
             return
+        count = max(1, int(config.worker_zygote_count))
         env = dict(os.environ)
         # CPU-only stack in the zygote: no TPU plugin registration
         # (chip-bound workers keep the classic spawn path), no stale
@@ -754,53 +826,79 @@ class NodeManager:
         env.pop("PALLAS_AXON_POOL_IPS", None)
         for k in [k for k in env if k.startswith("RAY_TPU_")]:
             env.pop(k, None)
-        self._zygote_sock_path = os.path.join(
-            self.session_dir, f"zyg_{self.node_id[:12]}.sock")
-        env["RAY_TPU_ZYGOTE_SOCKET"] = self._zygote_sock_path
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        log = os.path.join(log_dir, f"zygote-{self.node_id[:12]}.log")
-        try:
-            with open(log, "ab") as f:
-                self._zygote = subprocess.Popen(
-                    [sys.executable, "-m",
-                     "ray_tpu._private.worker_zygote"],
-                    env=env, stdout=f, stderr=f)
-        except OSError:
-            self._zygote = None
+        for i in range(count):
+            sock_path = os.path.join(
+                self.session_dir, f"zyg{i}_{self.node_id[:12]}.sock")
+            zenv = dict(env)
+            zenv["RAY_TPU_ZYGOTE_SOCKET"] = sock_path
+            log = os.path.join(log_dir,
+                               f"zygote{i}-{self.node_id[:12]}.log")
+            try:
+                with open(log, "ab") as f:
+                    proc = subprocess.Popen(
+                        [sys.executable, "-m",
+                         "ray_tpu._private.worker_zygote"],
+                        env=zenv, stdout=f, stderr=f)
+            except OSError:
+                continue
+            self._zygotes.append({"proc": proc, "sock_path": sock_path,
+                                  "io": None, "lock": threading.Lock()})
 
     def _zygote_fork(self, req: dict) -> Optional[_ForkedProc]:
-        """Ask the zygote for a forked worker; None falls back to the
-        classic spawn (zygote still starting, or dead)."""
-        if self._zygote is None or self._zygote.poll() is not None:
+        """Ask a fork-server for a forked worker; None falls back to the
+        classic spawn (zygotes still starting, or all dead). Picks an
+        UNCONTENDED zygote when one exists (try-acquire sweep), else
+        round-robins — concurrent spawns fan out across the pool."""
+        live = [z for z in self._zygotes
+                if z["proc"].poll() is None]
+        if not live:
             return None
-        # raylint: disable-next=blocking-under-lock (this lock EXISTS to
-        # serialize the one fork conversation on the zygote socket —
-        # every waiter wants exactly this IO, and the socket carries a
-        # 10s settimeout so a dead zygote cannot wedge spawners)
-        with self._zygote_lock:
-            try:
-                if self._zygote_io is None:
-                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                    s.settimeout(10.0)
-                    s.connect(self._zygote_sock_path)
-                    self._zygote_io = (s, s.makefile("rwb"))
-                _, f = self._zygote_io
-                f.write((json.dumps(req) + "\n").encode())
-                f.flush()
-                line = f.readline()
-                if not line:
-                    raise OSError("zygote connection closed")
-                return _ForkedProc(int(json.loads(line)["pid"]),
-                                   self._zygote_sock_path + ".exits")
-            except (OSError, ValueError, KeyError):
-                io, self._zygote_io = self._zygote_io, None
-                if io is not None:
-                    try:
-                        io[0].close()
-                    except OSError:
-                        pass
-                return None
+        target = None
+        for z in live:
+            if z["lock"].acquire(False):
+                target = z
+                break
+        if target is None:
+            target = live[next(self._zygote_rr) % len(live)]
+            # raylint: disable-next=unbounded-wait (in-process lock held
+            # only around a 10s-bounded socket conversation)
+            target["lock"].acquire()
+        try:
+            return self._zygote_fork_locked(target, req)
+        finally:
+            target["lock"].release()
+
+    def _zygote_fork_locked(self, z: dict,
+                            req: dict) -> Optional[_ForkedProc]:
+        # The zygote's conversation lock is held: the socket IO below is
+        # the exact resource the lock serializes, bounded by a 10s
+        # settimeout so a dead zygote cannot wedge spawners.
+        try:
+            if z["io"] is None:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.settimeout(10.0)
+                s.connect(z["sock_path"])
+                z["io"] = (s, s.makefile("rwb"))
+            _, f = z["io"]
+            f.write((json.dumps(req) + "\n").encode())
+            f.flush()
+            # raylint: disable-next=unbounded-wait (socket carries a 10s
+            # settimeout from connect time)
+            line = f.readline()
+            if not line:
+                raise OSError("zygote connection closed")
+            return _ForkedProc(int(json.loads(line)["pid"]),
+                               z["sock_path"] + ".exits")
+        except (OSError, ValueError, KeyError):
+            io, z["io"] = z["io"], None
+            if io is not None:
+                try:
+                    io[0].close()
+                except OSError:
+                    pass
+            return None
 
     def _spawn_worker(self, dedicated: bool = False,
                       env_extra: Optional[Dict[str, str]] = None,
@@ -809,74 +907,69 @@ class NodeManager:
                       extra_pythonpath: Optional[List[str]] = None
                       ) -> WorkerHandle:
         worker_id = WorkerID.from_random().binary()
-        env = dict(os.environ)
-        if not tpu_chips:
-            # CPU-only worker: skip the TPU PJRT plugin preimport at python
-            # startup (the analog of hiding GPUs via CUDA_VISIBLE_DEVICES=""
-            # in the reference). TPU tasks/actors always get freshly spawned
-            # workers with the full TPU environment.
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update(env_extra or {})
+        # Identity vars every worker needs. The zygote fast path ships
+        # ONLY these + the import roots (the zygote already holds the
+        # base environment) — assembling a full os.environ copy per
+        # spawn showed up in head-process profiles under actor churn;
+        # the classic path builds it lazily below.
+        ident = {
+            "RAY_TPU_WORKER_ID": worker_id.hex(),
+            "RAY_TPU_NM_ADDRESS": self.address,
+            "RAY_TPU_GCS_ADDRESS": self.gcs_address,
+            "RAY_TPU_STORE_PATH": self.store_path,
+            "RAY_TPU_NODE_ID": self.node_id,
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+        }
         # Workers resolve by-reference pickles (functions defined in driver
         # modules) by importing the same modules, so they need the driver's
         # import roots (reference: runtime_env working_dir ships driver code
         # to workers; same-host equivalent is sharing sys.path).
         roots = list(extra_pythonpath or [])
-        roots += [p for p in sys.path if p and os.path.isdir(p)]
-        prior = env.get("PYTHONPATH")
-        if prior:
-            roots.append(prior)
-        env["PYTHONPATH"] = os.pathsep.join(roots)
-        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
-        env["RAY_TPU_NM_ADDRESS"] = self.address
-        env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
-        env["RAY_TPU_STORE_PATH"] = self.store_path
-        env["RAY_TPU_NODE_ID"] = self.node_id
-        env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        if cwd is not None or extra_pythonpath:
-            # Runtime-env isolation: the worker must NOT later prepend
-            # driver sys.path entries ahead of its pinned working_dir /
-            # py_modules snapshot (worker_main honors this flag).
-            env["RAY_TPU_ISOLATED_ENV"] = "1"
-        if tpu_chips:
-            # Restrict the worker's XLA client to its assigned chips.
-            env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
-            env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,{len(tpu_chips)},1"
+        roots += serialization.import_roots()
+
+        def build_env():
+            env = dict(os.environ)
+            if not tpu_chips:
+                # CPU-only worker: skip the TPU PJRT plugin preimport at
+                # python startup (the analog of hiding GPUs via
+                # CUDA_VISIBLE_DEVICES="" in the reference). TPU
+                # tasks/actors always get freshly spawned workers with
+                # the full TPU environment.
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.update(env_extra or {})
+            prior = env.get("PYTHONPATH")
+            allroots = roots + ([prior] if prior else [])
+            env["PYTHONPATH"] = os.pathsep.join(allroots)
+            env.update(ident)
+            if cwd is not None or extra_pythonpath:
+                # Runtime-env isolation: the worker must NOT later
+                # prepend driver sys.path entries ahead of its pinned
+                # working_dir / py_modules snapshot (worker_main honors
+                # this flag).
+                env["RAY_TPU_ISOLATED_ENV"] = "1"
+            if tpu_chips:
+                # Restrict the worker's XLA client to its assigned chips.
+                env["TPU_VISIBLE_CHIPS"] = ",".join(
+                    str(c) for c in tpu_chips)
+                env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = \
+                    f"1,{len(tpu_chips)},1"
+            return env
+
         # Worker stdout/stderr -> per-worker session log files (reference:
         # default_worker.py redirection + log_monitor.py:104 tailing); the
         # node's log monitor streams new lines to the GCS, which forwards
         # them to drivers that asked for log_to_driver.
-        log_dir = os.path.join(self.session_dir, "logs")
-        os.makedirs(log_dir, exist_ok=True)
+        log_dir = self._log_dir
         wid12 = worker_id.hex()[:12]
         out_path = os.path.join(log_dir, f"worker-{wid12}.out")
         err_path = os.path.join(log_dir, f"worker-{wid12}.err")
-        proc = None
-        if not tpu_chips and cwd is None and not extra_pythonpath \
-                and not env_extra:
-            # Plain CPU worker: fork from the pre-imported zygote
-            # (interpreter start + imports already paid). Worker vars
-            # only — the zygote holds the base environment.
-            proc = self._zygote_fork({
-                "env": {k: env[k] for k in (
-                    "RAY_TPU_WORKER_ID", "RAY_TPU_NM_ADDRESS",
-                    "RAY_TPU_GCS_ADDRESS", "RAY_TPU_STORE_PATH",
-                    "RAY_TPU_NODE_ID", "RAY_TPU_SESSION_DIR")},
-                "stdout": out_path, "stderr": err_path,
-                "cwd": None,
-                "sys_path": [p for p in roots if p],
-            })
-        if proc is None:
-            with open(out_path, "ab") as f_out, \
-                    open(err_path, "ab") as f_err:
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
-                    env=env,
-                    cwd=cwd or os.getcwd(),
-                    stdout=f_out,
-                    stderr=f_err,
-                )
-        handle = WorkerHandle(worker_id=worker_id, proc=proc,
+        # Record the handle BEFORE the fork/exec: a zygote-forked child
+        # can boot and call register_worker in single-digit ms — faster
+        # than this thread re-takes the GIL after the fork conversation
+        # — and registration must find the handle. The placeholder proc
+        # answers poll() None ("still starting") until the real one
+        # lands below.
+        handle = WorkerHandle(worker_id=worker_id, proc=_SPAWNING,
                               dedicated=dedicated, tpu_chips=tpu_chips or [],
                               env_key=(tuple(sorted(env_extra.items()))
                                        if env_extra else None),
@@ -885,6 +978,49 @@ class NodeManager:
                               log_offsets={"stdout": 0, "stderr": 0})
         with self._lock:
             self._workers[worker_id] = handle
+        proc = None
+        try:
+            if not tpu_chips and cwd is None and not extra_pythonpath \
+                    and not env_extra:
+                # Plain CPU worker: fork from the pre-imported zygote
+                # (interpreter start + imports already paid). Worker vars
+                # only — the zygote holds the base environment.
+                proc = self._zygote_fork({
+                    "env": ident,
+                    "stdout": out_path, "stderr": err_path,
+                    "cwd": None,
+                    "sys_path": [p for p in roots if p],
+                })
+            if proc is None:
+                with open(out_path, "ab") as f_out, \
+                        open(err_path, "ab") as f_err:
+                    proc = subprocess.Popen(
+                        [sys.executable, "-m",
+                         "ray_tpu._private.worker_main"],
+                        env=build_env(),
+                        cwd=cwd or os.getcwd(),
+                        stdout=f_out,
+                        stderr=f_err,
+                    )
+        except BaseException:
+            # Spawn failed. The handle was visible in _workers during
+            # the fork window, so a lease checkout or an actor creation
+            # may already have CLAIMED it (lease_reply parked, actor
+            # registered, resource holds bound) — those must unwind
+            # through the normal worker-death path or the caller hangs
+            # forever on a worker that never existed. Unclaimed
+            # placeholders just vanish.
+            with self._lock:
+                claimed = (handle.actor_id is not None
+                           or handle.lease_reply is not None
+                           or handle.leased_conn is not None)
+                if not claimed:
+                    self._workers.pop(worker_id, None)
+            if claimed:
+                handle.death_reason = "worker spawn failed"
+                self._on_worker_death(handle)
+            raise
+        handle.proc = proc
         return handle
 
     def _on_server_disconnect(self, conn: protocol.Conn):
@@ -1007,11 +1143,22 @@ class NodeManager:
                 self._report_task_done(tid, "crashed", [],
                                        error=str(err))
         if actor_id is not None:
+            push = False
             with self._lock:
                 self._actors.pop(actor_id, None)
                 held = self._res_held_actors.pop(actor_id, None)
                 if held:
                     self._local_avail.release(held)
+                if actor_id in self._local_actor_ids:
+                    # Locally-placed actor (decentralized creation): the
+                    # shape leaves the local_held aggregate with it.
+                    self._local_actor_ids.discard(actor_id)
+                    if held:
+                        self._local_held.subtract(held)
+                        self._local_held_seq += 1
+                        push = True
+            if push:
+                self._push_resource_report()
             try:
                 self.gcs.notify("actor_state", {
                     "actor_id": actor_id,
@@ -1411,6 +1558,13 @@ class NodeManager:
             self._on_worker_death(w)
 
     def _dispatch_queued(self):
+        if not self._task_queue:
+            # GIL-atomic emptiness peek: this runs after EVERY worker
+            # registration / task completion / lease release, and taking
+            # the NM lock just to learn the queue is empty convoys those
+            # paths under churn. Enqueue+check is atomic under the lock
+            # on the enqueueing side, so no wakeup can be lost.
+            return
         while True:
             dispatch = None
             with self._lock:
@@ -1485,6 +1639,7 @@ class NodeManager:
         # tasks from the pool, worker_pool.h:340).
         if cwd is None and not pypaths and not env:
             refill = False
+            claimed = False
             with self._lock:
                 w = self._pop_tpu_idle_locked(k, None) if k > 0 \
                     else self._pop_idle_locked()
@@ -1496,6 +1651,38 @@ class NodeManager:
                     self._actors[spec.actor_id.binary()] = w
                     conn = w.conn
                     refill = k == 0 and self._maybe_refill_pool_locked()
+                elif k == 0:
+                    # No idle worker: claim an unclaimed in-flight spawn
+                    # (boot fill / pool refill) before herding a fresh
+                    # process — the creation parks in pending_pushes and
+                    # delivers at registration, pipelining actor churn
+                    # with worker boot (the lease checkout's spare-spawn
+                    # claim, applied to actors). Only SPARE spawns: ones
+                    # the classic _task_queue counts on must reach the
+                    # idle pool.
+                    spare = [cand for cand in self._workers.values()
+                             if cand.state == STARTING
+                             and not cand.dedicated
+                             and cand.lease_reply is None
+                             and cand.leased_conn is None
+                             and cand.actor_id is None]
+                    if len(spare) > len(self._task_queue):
+                        w2 = spare[0]
+                        w2.dedicated = True
+                        w2.state = ACTOR
+                        w2.actor_id = spec.actor_id.binary()
+                        w2.actor_spec = spec
+                        self._actors[spec.actor_id.binary()] = w2
+                        w2.pending_pushes.append(("create_actor", spec))
+                        claimed = True
+                        refill = self._maybe_refill_pool_locked()
+            if claimed:
+                if refill:
+                    try:
+                        self._spawn_worker()
+                    except BaseException:
+                        logger.exception("pool refill spawn failed")
+                return
             if w is not None:
                 try:
                     conn.notify("create_actor", spec)
@@ -1664,6 +1851,13 @@ class NodeManager:
                 self._on_lease_worker(conn, payload, msg_id)
             elif mtype == protocol.REQUEST_LOCAL_LEASE:
                 self._on_request_local_lease(conn, payload, msg_id)
+            elif mtype == protocol.REQUEST_CREATE_ACTOR:
+                # Off the serve thread: creation spawns a worker (zygote
+                # fork) — inline it and a burst of creations serializes
+                # behind one fork conversation per actor, stalling every
+                # other message on this conn.
+                self._actor_exec.submit(
+                    self._request_create_actor_safe, conn, payload, msg_id)
             elif mtype == protocol.RETURN_LOCAL_LEASE:
                 self._on_return_local_lease(conn, payload)
             elif mtype == protocol.SCHEDULER_STATS:
@@ -1745,6 +1939,19 @@ class NodeManager:
     def _on_register_worker(self, conn, p, msg_id):
         wid = p["worker_id"]
         lease_reply = None
+        # Spawn-registration race: a zygote-forked child can boot and
+        # dial back before the spawner thread re-takes the GIL to record
+        # the WorkerHandle (parallel fork-servers made this window
+        # real). This serve thread belongs to the registering worker's
+        # own conn, so a short bounded wait blocks nobody else.
+        deadline = time.time() + 5.0
+        while True:
+            with self._lock:
+                w = self._workers.get(wid)
+            if w is not None or time.time() >= deadline \
+                    or self._shutdown:
+                break
+            time.sleep(0.002)
         with self._lock:
             w = self._workers.get(wid)
             if w is None:
@@ -1776,11 +1983,40 @@ class NodeManager:
                     else:
                         w.state = IDLE
                         self._idle.append(w)
+                # Deliver parked pushes UNDER the lock, before any other
+                # path can observe w.conn non-None: _on_submit_actor_task
+                # sends inline the moment it sees a conn, and an inline
+                # run_actor_task must never overtake the parked
+                # create_actor on the same conn (the conn's writer
+                # thread preserves _send call order; notify is a
+                # non-blocking queue append, safe under the lock).
+                push_fail = None
+                for i, (mtype, payload) in enumerate(pushes):
+                    try:
+                        conn.notify(mtype, payload)
+                    except protocol.ConnectionClosed:
+                        push_fail = i
+                        break
+                    if mtype == "run_actor_task":
+                        # Delivered: the worker's receive-time pin owns
+                        # the args now; release the parked-window node
+                        # pin.
+                        self._refcount_delta(payload.arg_deps, -1)
         if reject:
             try:
                 conn.reply_error(msg_id, "worker was reaped at startup")
             except protocol.ConnectionClosed:
                 pass
+            return
+        if push_fail is not None:
+            # pending_pushes was already swapped out above, so the death
+            # path can't see these: release the parked-window node pins
+            # of every remaining undelivered run_actor_task here, or
+            # they leak until node death.
+            for fm, fp in pushes[push_fail:]:
+                if fm == "run_actor_task":
+                    self._refcount_delta(fp.arg_deps, -1)
+            self._on_worker_death(w)
             return
         conn.reply(msg_id, {"node_id": self.node_id})
         if lease_reply is not None:
@@ -1793,23 +2029,6 @@ class NodeManager:
                                       **(w.lease_grant or {})})
             except protocol.ConnectionClosed:
                 self._release_leased_worker(w)
-        for i, (mtype, payload) in enumerate(pushes):
-            try:
-                conn.notify(mtype, payload)
-            except protocol.ConnectionClosed:
-                # pending_pushes was already swapped out above, so the
-                # death path can't see these: release the parked-window
-                # node pins of this and every remaining undelivered
-                # run_actor_task here, or they leak until node death.
-                for fm, fp in pushes[i:]:
-                    if fm == "run_actor_task":
-                        self._refcount_delta(fp.arg_deps, -1)
-                self._on_worker_death(w)
-                return
-            if mtype == "run_actor_task":
-                # Delivered: the worker's receive-time pin owns the args
-                # now; release the parked-window node pin.
-                self._refcount_delta(payload.arg_deps, -1)
         self._dispatch_queued()
 
     def _on_lease_worker(self, conn, p, msg_id):
@@ -1920,10 +2139,22 @@ class NodeManager:
     _demand_overlaps = staticmethod(demand_overlaps)
 
     def _release_actor_hold(self, aid: bytes) -> None:
+        push = False
         with self._lock:
             held = self._res_held_actors.pop(aid, None)
             if held:
                 self._local_avail.release(held)
+            if aid in self._local_actor_ids:
+                # Locally-placed actor: its shape also rides the
+                # local_held aggregate — return it there too, or the GCS
+                # subtracts phantom holds forever.
+                self._local_actor_ids.discard(aid)
+                if held:
+                    self._local_held.subtract(held)
+                    self._local_held_seq += 1
+                    push = True
+        if push:
+            self._push_resource_report()
 
     def _on_request_local_lease(self, conn, p, msg_id):
         """Grant (or decline) a worker lease from the local free-resource
@@ -1971,6 +2202,97 @@ class NodeManager:
                 conn.reply(msg_id, None)   # decline -> caller spills back
             except Exception:
                 pass
+
+    def _request_create_actor_safe(self, conn, spec: ActorCreationSpec,
+                                   msg_id):
+        """Executor-side guard: an unexpected raise must still resolve
+        the driver's grant future (reply_error -> classic spillback) and
+        release a recorded grant, or the driver parks forever and the
+        ledger leaks the shape."""
+        try:
+            self._on_request_create_actor(conn, spec, msg_id)
+        except Exception as e:
+            logger.exception("request_create_actor failed")
+            aid = spec.actor_id.binary()
+            self._release_actor_hold(aid)
+            try:
+                # If the placement report already went out, bury the
+                # actor so the driver's re-create lands on a DEAD entry
+                # (which the GCS create handler replaces).
+                self.gcs.notify("actor_state", {
+                    "actor_id": aid, "state": "DEAD",
+                    "creation_failed": True,
+                    "error": f"local creation failed: {e}"})
+            except Exception:
+                pass
+            try:
+                conn.reply_error(msg_id, f"{type(e).__name__}: {e}")
+            except protocol.ConnectionClosed:
+                pass
+
+    def _on_request_create_actor(self, conn, spec: ActorCreationSpec,
+                                 msg_id):
+        """Decentralized actor creation (the actor analog of
+        request_local_lease — reference: the hybrid policy's bottom-up
+        placement, raylet/scheduling/policy/hybrid_scheduling_policy.h):
+        place the actor from the LOCAL free-resource ledger without ever
+        taking a GCS lock on the happy path. On grant: the shape joins
+        the local_held aggregate (seq-versioned heartbeat reports carry
+        it, exactly like lease grants), the GCS learns of the placement
+        via an async ``actor_placed`` notify — sent on the NM's GCS conn
+        BEFORE any later actor_state for this actor, so same-conn FIFO
+        gives the GCS creation-before-lifecycle ordering — and the
+        worker spawns through the normal create path (pool conversion /
+        zygote fork). A None reply is spillback: the driver falls back
+        to the classic GCS-scheduled creation.
+
+        The grant reply is sent only AFTER _on_create_actor bound the
+        actor to a worker handle, so a submit_actor_task racing the
+        reply always finds the actor registered here."""
+        from ray_tpu._private import runtime_env as renv_mod
+
+        res = dict(spec.resources or {})
+        aid = spec.actor_id.binary()
+        now = time.time()
+        with self._lock:
+            granted = (
+                not self._shutdown
+                and not res.get(TPU)
+                # Isolated runtime_envs materialize off-thread; keep the
+                # reply-after-registration invariant by spilling back.
+                and not renv_mod.needs_isolation(spec.runtime_env)
+                and not (now < self._local_backoff_until
+                         and any(self._demand_overlaps(d, res)
+                                 for d in self._local_backoff_demands))
+                and self._local_avail.acquire(res)
+            )
+            if granted:
+                # Custody passes to the actor registries: the death /
+                # creation-failure paths release both holds.
+                self._res_held_actors[aid] = res
+                self._local_actor_ids.add(aid)
+                self._local_held.add(res)
+                self._local_held_seq += 1
+                self.local_actor_grants_total += 1
+                held = self._local_held.to_dict()
+                held_seq = self._local_held_seq
+            else:
+                self.local_actor_spillbacks_total += 1
+        if not granted:
+            conn.reply(msg_id, None)
+            return
+        try:
+            # The placement report doubles as the eager resource report:
+            # the local_held aggregate rides in the same notify (one GCS
+            # send per creation, not two; seq-guarded like heartbeats).
+            self.gcs.notify(protocol.ACTOR_PLACED, {
+                "spec": spec, "node_id": self.node_id,
+                "local_held": held, "local_held_seq": held_seq})
+        except Exception:
+            pass   # GCS redialing: the rejoin re-report covers live actors
+        self._on_create_actor(spec)
+        conn.reply(msg_id, {"node_id": self.node_id,
+                            "address": self.address})
 
     def _release_local_grant(self, lease_id) -> bool:
         if lease_id is None:
@@ -2054,6 +2376,10 @@ class NodeManager:
                 "local_grants_total": self.local_grants_total,
                 "local_spillbacks_total": self.local_spillbacks_total,
                 "local_grants_open": len(self._local_grants),
+                "local_actor_grants_total": self.local_actor_grants_total,
+                "local_actor_spillbacks_total":
+                    self.local_actor_spillbacks_total,
+                "local_actors_open": len(self._local_actor_ids),
                 "local_held": self._local_held.to_dict(),
                 "local_available": self._local_avail.to_dict(),
             }

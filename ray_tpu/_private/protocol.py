@@ -38,6 +38,13 @@ RETURN_LOCAL_LEASE = "return_local_lease"      # caller -> own NM (notify)
 REVOKE_LOCAL_LEASE = "revoke_local_lease"      # GCS -> NM (fairness, notify)
 REVOKE_LEASE = "revoke_lease"                  # NM/GCS -> holder (notify)
 SCHEDULER_STATS = "scheduler_stats"            # any -> NM (request)
+# Decentralized actor creation (the actor analog of the local-first task
+# lease): the driver asks its OWN node manager to place the actor; the NM
+# reports the placement to the GCS asynchronously. ACTOR_PLACED must be
+# sent on the NM's GCS conn BEFORE any actor_state for the same actor —
+# same-conn FIFO is the ordering guarantee the GCS relies on.
+REQUEST_CREATE_ACTOR = "request_create_actor"  # driver -> own NM (request)
+ACTOR_PLACED = "actor_placed"                  # NM -> GCS (notify)
 
 
 class ConnectionClosed(Exception):

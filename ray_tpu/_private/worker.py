@@ -41,6 +41,10 @@ from ray_tpu._private.task_spec import (
 )
 from ray_tpu.object_store import plasma
 
+import logging
+
+logger = logging.getLogger("ray_tpu.worker")
+
 _INLINE_ARG_LIMIT = 512 * 1024  # larger arg blobs go through the object store
 
 
@@ -244,10 +248,17 @@ class _ObjArg:
         self.id_bytes = id_bytes
 
 
-def _tracing():
-    from ray_tpu.util import tracing
+_tracing_mod = None
 
-    return tracing
+
+def _tracing():
+    # Lazy to dodge the import cycle at module load; cached after.
+    global _tracing_mod
+    if _tracing_mod is None:
+        from ray_tpu.util import tracing
+
+        _tracing_mod = tracing
+    return _tracing_mod
 
 
 class _RefTracker:
@@ -494,10 +505,28 @@ class CoreWorker:
         # whole decentralized path — every task then serializes through
         # the central GCS scheduler (the A/B baseline).
         self._lease_mgr = None
-        if _cfg.lease_enabled and _cfg.local_scheduling_enabled:
+        self._lease_mgr_lock = threading.Lock()
+        self._lease_wanted = bool(_cfg.lease_enabled
+                                  and _cfg.local_scheduling_enabled)
+        if self._lease_wanted and role == "driver":
             from ray_tpu._private.lease import LeaseManager
 
             self._lease_mgr = LeaseManager(self)
+        # Workers get theirs lazily, on their first task submission:
+        # LeaseManager construction costs a nodes() RPC + an NM pre-dial
+        # + a flusher thread, and most actor/task workers never submit —
+        # under a 200-actor churn burst those boot RPCs alone saturate
+        # the head process.
+
+    def _ensure_lease_mgr(self):
+        if self._lease_mgr is None and self._lease_wanted \
+                and not self._closed:
+            from ray_tpu._private.lease import LeaseManager
+
+            with self._lease_mgr_lock:
+                if self._lease_mgr is None:
+                    self._lease_mgr = LeaseManager(self)
+        return self._lease_mgr
 
     def _route_submit(self, fn, *args):
         try:
@@ -934,6 +963,10 @@ class CoreWorker:
                 raise TypeError(f"get() list items must be ObjectRef, got "
                                 f"{type(r)}")
         ids = [r.binary() for r in refs]
+        lm = self._lease_mgr
+        if lm is not None:
+            # About to block: ship any coalesced submit batches first.
+            lm.flush_sends()
         # Same-process device-object handoff: refs whose value this
         # process itself put resolve by reference — no store read, no
         # GCS wait, no DMA (the array never left HBM).
@@ -972,6 +1005,9 @@ class CoreWorker:
         if len(set(r.binary() for r in refs)) != len(refs):
             raise ValueError("wait() got duplicate ObjectRefs")
         ids = [r.binary() for r in refs]
+        if self._lease_mgr is not None:
+            # About to block: ship any coalesced submit batches first.
+            self._lease_mgr.flush_sends()
         local = {o for o in ids if self.store.contains(o)}
         ready_set = set(local)
         if self._lease_mgr is not None and len(ready_set) < num_returns:
@@ -1124,7 +1160,7 @@ class CoreWorker:
         )
         # Direct transport first: plain tasks stream to a leased worker
         # (submit() declines when closed/over capacity -> scheduled path).
-        lm = self._lease_mgr
+        lm = self._lease_mgr or self._ensure_lease_mgr()
         if not (lm is not None
                 and lm.eligible(resources, scheduling_strategy,
                                 placement_group, runtime_env)
@@ -1184,16 +1220,103 @@ class CoreWorker:
             placement_group_bundle_index=placement_group_bundle_index,
             runtime_env=runtime_env,
             class_name=class_name,
-            sys_path=[p for p in sys.path if p and os.path.isdir(p)],
+            sys_path=list(serialization.import_roots()),
             trace_ctx=_tracing().for_submit(),
         )
-        self.gcs.request("create_actor", spec)
         with self._actor_lock:
             self._actor_routes[actor_id.binary()] = {
                 "address": None, "pending": [], "resolving": False,
                 "info": {"max_task_retries": max_task_retries},
             }
+        # Decentralized creation first: the local node manager places
+        # eligible actors from its own ledger — no GCS lock, no central
+        # round trip on the happy path; declines spill back to the
+        # classic GCS-scheduled creation below.
+        if not self._try_local_create_actor(spec):
+            self.gcs.request("create_actor", spec)
         return actor_id
+
+    @staticmethod
+    def _local_actor_eligible(spec: ActorCreationSpec) -> bool:
+        """NM-local creation handles only plain actors, mirroring the
+        task-lease fast path: placement groups, affinity/spread, TPU
+        shapes (chip binding at spawn is node-chosen), runtime_envs, and
+        NAMED actors (the GCS owns name uniqueness) take the scheduled
+        path."""
+        return (spec.placement_group_id is None
+                and not spec.name
+                and (spec.scheduling_strategy is None
+                     or spec.scheduling_strategy == "DEFAULT")
+                and not spec.runtime_env
+                and not (spec.resources or {}).get("TPU"))
+
+    def _try_local_create_actor(self, spec: ActorCreationSpec) -> bool:
+        """Ask OUR node manager to place the actor (decentralized actor
+        creation, the actor analog of request_local_lease). Returns True
+        when the request was handed off — the grant/spillback resolves
+        asynchronously on the route executor; actor method calls park on
+        the route meanwhile. False = caller must use the classic path."""
+        from ray_tpu._private.config import config as _cfg
+
+        if not (bool(_cfg.local_actor_creation_enabled)
+                and bool(_cfg.local_scheduling_enabled)):
+            return False
+        if not self._local_actor_eligible(spec):
+            return False
+        addr = self._own_nm_address()
+        if not addr:
+            return False
+        try:
+            nm = self.nm_conn(addr)
+        except (ConnectionError, OSError):
+            return False
+        aid = spec.actor_id.binary()
+        route = self._route_for(aid)   # takes _actor_lock internally
+        with self._actor_lock:
+            # Park method calls until the grant (or spillback) lands.
+            route["resolving"] = True
+            # Kept for NM-death recovery: if the node dies before its
+            # actor_placed report reaches the GCS, resolve_actor errors
+            # "actor not found" and the route re-creates via the GCS.
+            route["create_spec"] = spec
+        try:
+            fut = nm.request_nowait(protocol.REQUEST_CREATE_ACTOR, spec)
+        except BaseException:
+            with self._actor_lock:
+                route["resolving"] = False
+            return False
+        fut.add_done_callback(
+            lambda f: self._route_submit(
+                self._on_local_create_reply, spec, addr, f))
+        return True
+
+    def _on_local_create_reply(self, spec, addr: str, f):
+        aid = spec.actor_id.binary()
+        try:
+            grant = f.result(0)
+        except BaseException:
+            grant = None
+        if grant is not None:
+            # Granted: the actor lives behind OUR node manager, which
+            # registered it before replying — publish the route and
+            # flush parked calls (no resolve_actor round trip at all).
+            self._on_actor_resolved(aid, {"state": "ALIVE",
+                                          "node_address": addr})
+            return
+        # Spillback: classic GCS-scheduled creation (we are on the route
+        # executor thread, so the blocking request is safe here).
+        try:
+            self.gcs.request("create_actor", spec)
+        except Exception as e:
+            logger.warning("actor creation spillback failed: %s", e)
+        route = self._route_for(aid)
+        with self._actor_lock:
+            route["resolving"] = False
+            need_resolve = bool(route["pending"])
+            if need_resolve:
+                route["resolving"] = True
+        if need_resolve:
+            self._resolve_actor_route(aid)
 
     def _route_for(self, actor_id_bytes: bytes) -> Dict[str, Any]:
         with self._actor_lock:
@@ -1319,6 +1442,14 @@ class CoreWorker:
         def on_done(f):
             try:
                 info = f.result(0)
+            except protocol.RemoteCallError as e:
+                if "actor not found" in str(e):
+                    # Locally-created actor whose node died before its
+                    # actor_placed report reached the GCS: re-create it
+                    # through the central path (once), then re-resolve.
+                    self._route_submit(self._recover_unplaced_actor, aid)
+                    return
+                info = {"state": "DEAD", "node_address": None}
             except BaseException:
                 info = {"state": "DEAD", "node_address": None}
             # _on_actor_resolved may dial the target node manager (blocking
@@ -1327,6 +1458,28 @@ class CoreWorker:
             self._route_submit(self._on_actor_resolved, aid, info)
 
         fut.add_done_callback(on_done)
+
+    def _recover_unplaced_actor(self, aid: bytes):
+        """NM-death recovery for decentralized creation: the GCS never
+        learned of the actor (node died with the placement report in
+        flight), so re-submit the retained creation spec centrally —
+        the actor re-places on a surviving node. One attempt: the spec
+        is consumed."""
+        with self._actor_lock:
+            route = self._actor_routes.get(aid) or {}
+            spec = route.pop("create_spec", None)
+        if spec is not None:
+            try:
+                self.gcs.request("create_actor", spec)
+            except Exception as e:
+                logger.warning("lost-actor re-creation failed: %s", e)
+                spec = None
+        if spec is None:
+            self._on_actor_resolved(aid, {"state": "DEAD",
+                                          "node_address": None,
+                                          "death_cause": "actor not found"})
+            return
+        self._resolve_actor_route(aid)
 
     def _on_actor_resolved(self, aid: bytes, info: dict):
         route = self._route_for(aid)

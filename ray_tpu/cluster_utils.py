@@ -71,7 +71,7 @@ class Cluster:
                 if alive >= len(self.nodes):
                     return True
             else:
-                with self.gcs._lock:
+                with self.gcs._sched_lock:
                     alive = sum(1 for n in self.gcs._nodes.values()
                                 if n.alive)
                 if alive >= len(self.nodes):

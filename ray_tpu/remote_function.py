@@ -55,6 +55,8 @@ class RemoteFunction:
         self._options = _merge_options(_TASK_DEFAULTS, options or {})
         self._function_key: Optional[str] = None
         self._exported_blob: Optional[bytes] = None
+        self._exported_core = None
+        self._normalized_resources: Optional[Dict[str, float]] = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -71,15 +73,25 @@ class RemoteFunction:
     def _ensure_exported(self, core) -> str:
         if self._exported_blob is None:
             self._exported_blob = cloudpickle.dumps(self._function)
-        return core.export_function(self._exported_blob)
+        if self._function_key is not None and core is self._exported_core:
+            # Same worker generation: the key is content-addressed and
+            # the upload already happened — skip the per-call sha1.
+            return self._function_key
+        self._function_key = core.export_function(self._exported_blob)
+        self._exported_core = core
+        return self._function_key
 
     def remote(self, *args, **kwargs):
         core = worker_mod.require_worker()
         o = self._options
         key = self._ensure_exported(core)
-        resources = normalize_resources(
-            o["num_cpus"], o["num_tpus"], o["num_gpus"], o["memory"],
-            o["resources"], default_cpus=1.0)
+        # Options are immutable per RemoteFunction (options() returns a
+        # new one): normalize once, not per task submission.
+        resources = self._normalized_resources
+        if resources is None:
+            resources = self._normalized_resources = normalize_resources(
+                o["num_cpus"], o["num_tpus"], o["num_gpus"], o["memory"],
+                o["resources"], default_cpus=1.0)
         strategy = o["scheduling_strategy"]
         pg = o["placement_group"]
         bundle_index = o["placement_group_bundle_index"]

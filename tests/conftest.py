@@ -44,6 +44,13 @@ LOCKDEP_MODULES = {
     "test_gang_fault_tolerance",
     "test_device_objects",
     "test_serve_llm",
+    # The GCS shard locks (sched/actor/obj/kv) carry a canonical rank
+    # order; these two modules drive the scale and fault-tolerance paths
+    # that exercise every cross-shard protocol, so the runtime witness
+    # asserts no rank inversion ever executes.
+    "test_scheduler_scale",
+    "test_gcs_fault_tolerance",
+    "test_actor_leases",
 }
 
 

@@ -88,7 +88,16 @@ def test_lease_idle_return_releases_resources(lease_cluster):
             break
         time.sleep(0.2)
     assert not any(st.leases for st in lm._shapes.values())
-    avail = ray_tpu.available_resources()
+    # The GCS view recovers via the NM's ASYNC resource reports (eager
+    # push on release edges + heartbeats): poll, bounded, instead of
+    # racing the two notify hops.
+    deadline = time.time() + 10
+    avail = {}
+    while time.time() < deadline:
+        avail = ray_tpu.available_resources()
+        if avail.get("CPU", 0) == 4.0:
+            break
+        time.sleep(0.2)
     assert avail.get("CPU", 0) == 4.0, avail
 
 

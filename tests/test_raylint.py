@@ -708,3 +708,76 @@ def test_lockdep_factory_wraps_only_ray_tpu_locks():
             lockdep.uninstall()
         lockdep.reset()
         lockdep.take_violations()
+
+
+# ----------------------------------------- GCS shard locks (SCALE_r06)
+
+GCSF = "ray_tpu/_private/gcs.py"   # a control-plane path
+
+
+def test_shard_locks_are_distinct_identities(tmp_path):
+    """The four GCS shard locks resolve to distinct creation-site
+    identities, so a rank inversion between any two is a reportable
+    cycle — the checker must NOT conflate them into one node (which
+    would reduce every inversion to an invisible self-edge)."""
+    v = lint_tree(tmp_path, {GCSF: (
+        "import threading\n"
+        "class GcsServer:\n"
+        "    def __init__(self):\n"
+        "        self._sched_lock = threading.RLock()\n"
+        "        self._actor_lock = threading.RLock()\n"
+        "        self._obj_lock = threading.RLock()\n"
+        "        self._kv_lock = threading.RLock()\n"
+        "    def forward(self):\n"
+        "        with self._sched_lock:\n"
+        "            with self._actor_lock:\n"
+        "                with self._obj_lock:\n"
+        "                    pass\n"
+        "    def kv_forward(self):\n"
+        "        with self._obj_lock:\n"
+        "            with self._kv_lock:\n"
+        "                pass\n"
+    )}, rules={"lock-order"})
+    assert v == []   # rank-forward nesting only: clean
+
+
+def test_shard_lock_rank_inversion_is_flagged(tmp_path):
+    """A handler nesting rank-backward (obj shard -> actor shard, e.g.
+    a scheduler pass invoked while the object shard is held) closes a
+    cycle against the canonical sched<actor<obj order and must be
+    reported — this is the exact shape raylint caught in review while
+    this PR's sharding landed."""
+    v = lint_tree(tmp_path, {GCSF: (
+        "import threading\n"
+        "class GcsServer:\n"
+        "    def __init__(self):\n"
+        "        self._actor_lock = threading.RLock()\n"
+        "        self._obj_lock = threading.RLock()\n"
+        "    def _schedule_actor(self):\n"
+        "        with self._actor_lock:\n"
+        "            with self._obj_lock:\n"
+        "                pass\n"
+        "    def _submit_holding_obj(self):\n"
+        "        with self._obj_lock:\n"
+        "            self._try_schedule()\n"
+        "    def _try_schedule(self):\n"
+        "        with self._actor_lock:\n"
+        "            pass\n"
+    )}, rules={"lock-order"})
+    cycles = [x for x in v if x.rule == "lock-order"
+              and "cycle" in x.message]
+    assert len(cycles) == 1
+    assert "_actor_lock" in cycles[0].message
+    assert "_obj_lock" in cycles[0].message
+
+
+def test_repo_gcs_shard_locks_registered():
+    """The real gcs.py registers all four shard locks as separate
+    reentrant identities (guards against a refactor collapsing them)."""
+    project = core.Project(core.collect_sources(
+        [core.REPO_ROOT + "/ray_tpu/_private/gcs.py"]))
+    reg = project.lock_registry()
+    for name in ("_sched_lock", "_actor_lock", "_obj_lock", "_kv_lock"):
+        lid = f"ray_tpu._private.gcs.GcsServer.{name}"
+        assert lid in reg, f"missing shard lock identity {lid}"
+        assert reg[lid]["reentrant"], f"{lid} must be an RLock"
