@@ -22,7 +22,13 @@ Measures, on one GCS process:
   GCS).
 
 Prints one JSON line per metric. Run: python benchmarks/scale_bench.py
-[N_tasks] [K_actors].
+[N_tasks] [K_actors] [--gcs-out-of-process {0,1}].
+
+``--gcs-out-of-process`` pins the GCS topology for the run (1 = the GCS
+in its own subprocess/interpreter, 0 = in the head process — the
+pre-SCALE_r07 baseline); per microbench_compare conventions the A/B is
+two runs of this script, one per mode, same box. Omitted = whatever the
+env/config says (default in-process).
 """
 
 import json
@@ -105,12 +111,43 @@ def _run_churn_child(enabled: bool, cycles: int, per_cycle: int) -> dict:
 
 
 def main():
-    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    k_actors = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    argv = sys.argv[1:]
+    args = []
+    gcs_oop = None
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--gcs-out-of-process"):
+            # Accept =VALUE, a space-separated VALUE, and the bare flag.
+            _, eq, v = a.partition("=")
+            if not eq and i + 1 < len(argv) and argv[i + 1].lower() in (
+                    "0", "1", "true", "false", "on", "off"):
+                i += 1
+                v = argv[i]
+            gcs_oop = v.strip().lower() not in ("0", "false", "off") \
+                if v else True
+        else:
+            args.append(a)
+        i += 1
+    n_tasks = int(args[0]) if len(args) > 0 else 100_000
+    k_actors = int(args[1]) if len(args) > 1 else 200
 
     import ray_tpu
+    from ray_tpu._private.config import config as _cfg
+
+    if gcs_oop is not None:
+        # Pin the topology for this process's cluster AND every child
+        # driver (they inherit the env; config reads it at import).
+        _cfg.set("gcs_out_of_process", gcs_oop)
+        os.environ["RAY_TPU_GCS_OUT_OF_PROCESS"] = "1" if gcs_oop else "0"
 
     ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    print(json.dumps({
+        "metric": "gcs_topology",
+        "value": "out_of_process" if bool(_cfg.gcs_out_of_process)
+        else "in_process",
+        "toggle": "--gcs-out-of-process / RAY_TPU_GCS_OUT_OF_PROCESS"}),
+        flush=True)
     from ray_tpu._private import worker as worker_mod
     try:
         @ray_tpu.remote(resources={"impossible": 1})

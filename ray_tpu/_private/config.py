@@ -101,6 +101,14 @@ class Config:
     def dump(self) -> Dict[str, Any]:
         return dict(self._values)
 
+    def diff_nondefault(self) -> Dict[str, Any]:
+        """Knobs whose current value differs from the registered default
+        — the blob a spawner ships to a child control-plane process so
+        programmatic ``set()`` overrides (tests, system_config) survive
+        the process boundary the way env vars do on their own."""
+        return {k: v for k, v in self._values.items()
+                if v != self._entries[k].default}
+
 
 config = Config()
 _d = config.define
@@ -319,6 +327,19 @@ _d("gcs_rpc_timeout_s", 60.0,
    "named-actor lookup) pass an explicit timeout instead.")
 _d("gcs_storage", "memory", "GCS table storage backend: memory | file.")
 _d("gcs_file_storage_path", "", "Path for the file storage backend.")
+_d("gcs_out_of_process", False,
+   "Run the GCS in its own subprocess (its own interpreter/GIL) instead "
+   "of inside the head process (reference: the standalone gcs_server "
+   "beside the raylet). The head node manager and the driver then talk "
+   "to it purely over the protocol socket, exactly like worker nodes — "
+   "GCS handler concurrency stops competing with the head NM and the "
+   "driver for one GIL. Default off so unit tests don't pay a process "
+   "spawn per init(); `ray_tpu start --head` and the scale bench turn "
+   "it on. Env: RAY_TPU_GCS_OUT_OF_PROCESS.")
+_d("gcs_bootstrap_timeout_s", 30.0,
+   "How long the spawner waits for the GCS subprocess to bind its "
+   "listener and write the bootstrap file (address + pid) into the "
+   "session dir before declaring the launch failed.")
 _d("gcs_recovery_grace_s", 10.0,
    "After a GCS restart, how long restored actors wait for their node to "
    "re-register before being treated as node-dead (restart budget applies).")

@@ -55,6 +55,7 @@ from __future__ import annotations
 import collections
 import itertools
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -73,6 +74,21 @@ from ray_tpu._private.task_spec import (
 )
 
 logger = logging.getLogger("ray_tpu.gcs")
+
+# Pseudo client id under which a standalone GCS process files its own
+# metric samples in the metrics table (no CoreWorker exists there to run
+# the usual reporter push); exempt from the conn-liveness expiry.
+_GCS_SELF_CLIENT = "gcs-self"
+
+_os_getpid = os.getpid
+
+
+def _os_sysconf(name: str):
+    try:
+        return int(os.sysconf(name))
+    except (ValueError, OSError, AttributeError):
+        return None
+
 
 # Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState)
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
@@ -330,6 +346,16 @@ class GcsServer:
         self._pub_ev = threading.Event()
 
         self._shutdown = threading.Event()
+        # Process self-stats (pid/rss/cpu/listener threads), sampled by
+        # the timer thread at the shard-metrics cadence and served via
+        # control_plane_stats. Standalone mode (main() below — the GCS
+        # as its own process) additionally pushes the samples into the
+        # metrics table so /metrics keeps carrying them across the
+        # process boundary (no CoreWorker lives in the GCS process to
+        # run the usual reporter push).
+        self._standalone = False
+        self._self_stats: Dict[str, Any] = {"pid": _os_getpid()}
+        self._proc_cpu_prev: Optional[Tuple[float, float]] = None
         if self._storage is not None:
             self._load_from_storage()
         self.server = protocol.Server(self._handle, host=host, port=port,
@@ -401,6 +427,7 @@ class GcsServer:
                     self._last_queue_retry = now
                     self._try_schedule()
             self._sample_shard_metrics(now)
+            self._sample_self_stats(now)
             for w in expired:
                 try:
                     w.conn.reply(w.msg_id, {
@@ -429,7 +456,7 @@ class GcsServer:
             return
         self._last_shard_sample = now
         try:
-            wait_h, depth_g = _shard_metrics()
+            wait_h, depth_g = _shard_metrics()[:2]
         except Exception:
             return
 
@@ -457,6 +484,77 @@ class GcsServer:
                 wait_h.observe(time.perf_counter() - t0,
                                tags={"shard": name})
                 depth_g.set(float(depth()), tags={"shard": name})
+
+    @staticmethod
+    def _read_self_rss() -> Optional[int]:
+        """Resident set size of THIS process from /proc/self/statm."""
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * (_os_sysconf("SC_PAGE_SIZE") or 4096)
+        except (OSError, ValueError, IndexError):
+            return None
+
+    @staticmethod
+    def _read_self_cpu() -> Optional[Tuple[float, float]]:
+        """(cpu_seconds, wall_ts) for THIS process from /proc/self/stat."""
+        try:
+            with open("/proc/self/stat") as f:
+                # comm may contain spaces; fields after ')' are fixed.
+                rest = f.read().rsplit(")", 1)[1].split()
+            hz = _os_sysconf("SC_CLK_TCK") or 100
+            return (int(rest[11]) + int(rest[12])) / hz, time.time()
+        except (OSError, ValueError, IndexError):
+            return None
+
+    def _sample_self_stats(self, now: float) -> None:
+        """GCS-process self observability (pid, rss, cpu%, listener
+        threads, outbox depth), sampled on the shard-metrics cadence.
+        The dict is replaced wholesale so control_plane_stats can read
+        it lock-free (routing-read discipline)."""
+        if now - getattr(self, "_last_self_sample", 0.0) < \
+                self._SHARD_SAMPLE_PERIOD_S:
+            return
+        self._last_self_sample = now
+        cpu = self._read_self_cpu()
+        cpu_percent = None
+        prev = self._proc_cpu_prev
+        if cpu is not None and prev is not None and cpu[1] > prev[1]:
+            cpu_percent = round(
+                100.0 * (cpu[0] - prev[0]) / (cpu[1] - prev[1]), 1)
+        if cpu is not None:
+            self._proc_cpu_prev = cpu
+        listener_threads = sum(
+            1 for t in threading.enumerate()
+            if t.name.startswith("rtpu-conn-gcs"))
+        self._self_stats = {
+            "pid": _os_getpid(),
+            "rss_bytes": self._read_self_rss(),
+            "cpu_percent": cpu_percent,
+            "listener_threads": listener_threads,
+            "outbox_depth": len(self._pub_q),
+            "out_of_process": self._standalone,
+        }
+        try:
+            _wait_h, _depth_g, rss_g, cpu_g, thr_g = _shard_metrics()
+        except Exception:
+            return
+        st = self._self_stats
+        if st["rss_bytes"] is not None:
+            rss_g.set(float(st["rss_bytes"]))
+        if cpu_percent is not None:
+            cpu_g.set(float(cpu_percent))
+        thr_g.set(float(listener_threads))
+        if self._standalone:
+            # No CoreWorker in this process to push samples: the GCS IS
+            # the metrics table, so insert its own group directly.
+            from ray_tpu.util import metrics as metrics_mod
+
+            samples = metrics_mod.collect_samples()
+            with self._kv_lock:
+                self._metrics[_GCS_SELF_CLIENT] = {
+                    "samples": samples, "ts": now,
+                    "period_s": self._SHARD_SAMPLE_PERIOD_S * 3}
 
     def _publisher_loop(self):
         """Drain the record-then-publish outbox: snapshot each message's
@@ -2406,7 +2504,8 @@ class GcsServer:
             groups = []
             for cid, m in list(self._metrics.items()):
                 period = float(m.get("period_s") or 5.0)
-                if cid not in self._clients or \
+                if (cid != _GCS_SELF_CLIENT
+                        and cid not in self._clients) or \
                         now - m["ts"] > 3.0 * period:
                     del self._metrics[cid]
                     continue
@@ -2436,6 +2535,9 @@ class GcsServer:
             out["tracked_objects"] = len(self._obj_locations)
         with self._kv_lock:
             out["publish_outbox"] = len(self._pub_q)
+        # GCS-process self stats (pid/rss/cpu/listener threads): sampled
+        # by the timer thread, replaced wholesale — lock-free read.
+        out["gcs_process"] = dict(self._self_stats)
         conn.reply(msg_id, out)
 
     def _h_pending_demand(self, conn, p, msg_id):
@@ -2537,11 +2639,115 @@ def _shard_metrics():
                     "Per-domain GCS backlog (queued tasks / pending "
                     "actors / parked waiters+frees / publish outbox)",
                     tag_keys=("shard",))
+                rss_g = metrics.Gauge(
+                    "gcs_process_rss_bytes",
+                    "Resident memory of the process hosting the GCS")
+                cpu_g = metrics.Gauge(
+                    "gcs_process_cpu_percent",
+                    "CPU utilization of the process hosting the GCS "
+                    "(sampled over the shard-metrics period)")
+                thr_g = metrics.Gauge(
+                    "gcs_listener_threads",
+                    "Per-connection GCS listener threads currently alive")
                 metrics.start_reporter()
-                _shard_metric_cache = (wait_h, depth_g)
+                _shard_metric_cache = (wait_h, depth_g, rss_g, cpu_g,
+                                       thr_g)
     return _shard_metric_cache
 
 
 def p_kind(spec) -> str:
     return "actor" if isinstance(spec, (ActorCreationSpec, ActorTaskSpec)) \
         else "task"
+
+
+# ------------------------------------------------- standalone entrypoint
+# ``python -m ray_tpu._private.gcs``: the GCS as its own process with its
+# own interpreter/GIL (reference: the gcs_server binary started beside
+# the raylet by _private/node.py / services.py). The spawner
+# (gcs_launcher.GcsProcess) waits on the bootstrap file handshake; the
+# process serves until SIGTERM (graceful drain via GcsServer.close) or
+# until its spawning parent disappears.
+
+
+def _write_bootstrap(path: str, address: str) -> None:
+    """Atomic write (tmp + rename): the spawner polls for this file and
+    must never observe a torn read."""
+    import json as _json
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        _json.dump({"address": address, "pid": os.getpid()}, f)
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+    import sys
+
+    ap = argparse.ArgumentParser(prog="python -m ray_tpu._private.gcs")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--storage-path", default="")
+    ap.add_argument("--bootstrap-file", required=True)
+    ap.add_argument("--system-config", default="",
+                    help="JSON config blob shipped by the spawner "
+                         "(its non-default knobs)")
+    ap.add_argument("--check-parent-pid", type=int, default=0,
+                    help="exit when this process is no longer our "
+                         "parent (spawner died without cleanup)")
+    args = ap.parse_args(argv)
+
+    from ray_tpu._private.config import config as _cfg
+
+    if args.system_config:
+        _cfg.apply_system_config(args.system_config)
+    # Lockdep must wrap the shard locks at creation: install (knob- or
+    # env-driven) BEFORE the server is constructed.
+    from ray_tpu._private import lockdep
+
+    lockdep.maybe_install()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    server = GcsServer(host=args.host, port=args.port,
+                       storage_path=args.storage_path or None)
+    server._standalone = True
+    server._self_stats["out_of_process"] = True
+    _write_bootstrap(args.bootstrap_file, server.address)
+    logger.info("gcs serving at %s (pid %d)", server.address, os.getpid())
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    while not stop.wait(0.5):
+        if args.check_parent_pid and os.getppid() != args.check_parent_pid:
+            logger.warning("gcs parent process %d disappeared; draining",
+                           args.check_parent_pid)
+            break
+    # Graceful drain: notify node managers, close the listener, flush
+    # durable storage. The bootstrap file is removed so a later spawn in
+    # the same session dir can't read a stale handshake.
+    server.close()
+    try:
+        os.unlink(args.bootstrap_file)
+    except OSError:
+        pass
+    if lockdep.installed():
+        found = lockdep.take_violations()
+        if found:
+            for v in found:
+                print(f"gcs lockdep: {v}", file=sys.stderr)
+            return 3
+    return 0
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    _sys.exit(main())

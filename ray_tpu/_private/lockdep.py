@@ -310,6 +310,35 @@ def maybe_install() -> bool:
     return False
 
 
+def current_held() -> List[str]:
+    """Class keys of the tracked locks the CALLING thread holds right
+    now (empty when lockdep is not installed)."""
+    if not _installed:
+        return []
+    return [lk.class_key for lk in _held_stack()]
+
+
+def note_blocking_region(what: str) -> None:
+    """Record a violation if the calling thread enters a blocking region
+    (child-process wait, bootstrap poll, ...) while holding any tracked
+    lock. The runtime twin of raylint's blocking-under-lock checker for
+    blocking operations the static pass can't see into — e.g. the GCS
+    subprocess bootstrap/shutdown path, which must never wait on the
+    child while holding a control-plane lock. No-op unless installed."""
+    if not _installed:
+        return
+    held = _held_stack()
+    if not held:
+        return
+    cycle = [h.class_key for h in held] + [f"<blocking:{what}>"]
+    with _state_lock:
+        _violations.append(LockdepViolation(
+            cycle=cycle,
+            edge_sites=["(held at blocking region)"] * (len(cycle) - 1),
+            thread=threading.current_thread().name,
+            acquire_site=_caller_site()))
+
+
 def violations() -> List[LockdepViolation]:
     with _state_lock:
         return list(_violations)

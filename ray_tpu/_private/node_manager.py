@@ -677,7 +677,14 @@ class NodeManager:
                          name="rtpu-nm-rejoin").start()
 
     def _rejoin_gcs(self):
+        # Redial with exponential backoff: a restarting GCS process
+        # (out-of-process mode: real process death, not just a dropped
+        # socket) takes spawn + storage-restore time to come back —
+        # hammering the dead port at a fixed cadence buys nothing, and
+        # with every node redialing at once the backoff also spreads the
+        # re-registration stampede.
         deadline = time.time() + 300.0
+        backoff = 0.2
         while not self._shutdown and time.time() < deadline:
             try:
                 conn = protocol.connect(self.gcs_address,
@@ -685,7 +692,8 @@ class NodeManager:
                                         name=f"nm-gcs-{self._node_name}",
                                         timeout=5.0)
             except ConnectionError:
-                time.sleep(0.5)
+                time.sleep(backoff)
+                backoff = min(backoff * 1.6, 5.0)
                 continue
             with self._lock:
                 alive_actors = [aid for aid, w in self._actors.items()
@@ -715,7 +723,8 @@ class NodeManager:
                     conn.close()
                 except Exception:
                     pass
-                time.sleep(0.5)
+                time.sleep(backoff)
+                backoff = min(backoff * 1.6, 5.0)
                 continue
             conn.on_close = self._on_gcs_disconnect
             self.gcs = conn
@@ -1640,6 +1649,7 @@ class NodeManager:
         if cwd is None and not pypaths and not env:
             refill = False
             claimed = False
+            notify_failed = False
             with self._lock:
                 w = self._pop_tpu_idle_locked(k, None) if k > 0 \
                     else self._pop_idle_locked()
@@ -1650,6 +1660,21 @@ class NodeManager:
                     w.actor_spec = spec
                     self._actors[spec.actor_id.binary()] = w
                     conn = w.conn
+                    # The create notify MUST be enqueued in this same
+                    # critical section: the moment the _actors entry is
+                    # visible with a live conn, a concurrent
+                    # _on_submit_actor_task sends run_actor_task inline
+                    # — outside the lock the create can lose that race
+                    # and the worker executes a method on a
+                    # not-yet-created actor (seen as a NoneType
+                    # AttributeError under CPU contention). notify is a
+                    # non-blocking queue append, safe under the lock
+                    # (same rule as _on_register_worker's parked-push
+                    # flush).
+                    try:
+                        conn.notify("create_actor", spec)
+                    except protocol.ConnectionClosed:
+                        notify_failed = True
                     refill = k == 0 and self._maybe_refill_pool_locked()
                 elif k == 0:
                     # No idle worker: claim an unclaimed in-flight spawn
@@ -1684,9 +1709,7 @@ class NodeManager:
                         logger.exception("pool refill spawn failed")
                 return
             if w is not None:
-                try:
-                    conn.notify("create_actor", spec)
-                except protocol.ConnectionClosed:
+                if notify_failed:
                     self._on_worker_death(w)
                     return
                 if refill:
@@ -1726,6 +1749,7 @@ class NodeManager:
             except Exception:
                 pass
             return
+        notify_failed = False
         with self._lock:
             if cwd is not None or pypaths:
                 w.isolated = True
@@ -1733,7 +1757,23 @@ class NodeManager:
             w.actor_id = spec.actor_id.binary()
             w.actor_spec = spec
             self._actors[spec.actor_id.binary()] = w
-            w.pending_pushes.append(("create_actor", spec))
+            if w.conn is not None:
+                # The zygote-forked worker booted and REGISTERED before
+                # this bind (registration already flushed its
+                # pending_pushes — a push parked now would never be
+                # delivered, leaving the actor's worker create-less
+                # while inline run_actor_tasks reach it). Enqueue the
+                # create directly; doing it in this critical section
+                # keeps it ahead of any run_actor_task in the conn's
+                # send order (same rule as the idle-conversion branch).
+                try:
+                    w.conn.notify("create_actor", spec)
+                except protocol.ConnectionClosed:
+                    notify_failed = True
+            else:
+                w.pending_pushes.append(("create_actor", spec))
+        if notify_failed:
+            self._on_worker_death(w)
 
     def _on_kill_actor(self, p):
         with self._lock:

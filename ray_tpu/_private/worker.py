@@ -343,17 +343,21 @@ class _GcsChannel:
         # raylint: disable-next=blocking-under-lock (the redial lock:
         # every thread queued on it needs the very conn this dial is
         # establishing, and both the connect and the re-register carry
-        # explicit 30s bounds)
+        # explicit bounds (<=30s, tightened by gcs_rpc_timeout_s))
         with self._lock:
             if self._closed:
                 raise protocol.ConnectionClosed()
             if self._conn is not dead_conn and not self._conn.closed:
                 return self._conn  # another thread already reconnected
+            # Dial bound: a GCS that stays dead surfaces as a typed
+            # ConnectionError within the control-RPC budget, never a
+            # longer park than the caller's own timeout discipline.
+            dial = min(30.0, float(config.gcs_rpc_timeout_s))
             conn = protocol.connect(self._address, handler=self._handler,
-                                    name=self._name, timeout=30)
+                                    name=self._name, timeout=dial)
             if self._register_payload is not None:
                 conn.request("register_client", self._register_payload,
-                             timeout=30)
+                             timeout=dial)
             self._conn = conn
             return conn
 
@@ -1620,12 +1624,20 @@ _init_lock = threading.RLock()
 
 
 class _LocalCluster:
-    """In-process head: GCS + head-node manager (reference: the head node's
-    gcs_server + raylet processes, started by _private/node.py:1145)."""
+    """Locally-started head: GCS + head-node manager (reference: the head
+    node's gcs_server + raylet processes, started by
+    _private/node.py:1145).
+
+    The GCS runs either in-process (default — unit tests don't pay a
+    process spawn per init()) or, with ``gcs_out_of_process`` set, as a
+    dedicated subprocess with its own interpreter/GIL: the head node
+    manager and this driver then reach it purely over the protocol
+    socket, exactly like worker nodes — GCS handler concurrency stops
+    competing with the head NM and the driver for one GIL."""
 
     def __init__(self, num_cpus, num_tpus, resources, object_store_memory,
                  system_config=None, port: int = 0):
-        from ray_tpu._private.gcs import GcsServer
+        from ray_tpu._private.config import config as global_config
 
         # Apply overrides but remember the values they replaced: the
         # registry is process-global, so without restore-on-shutdown one
@@ -1633,7 +1645,6 @@ class _LocalCluster:
         # silently governs every later cluster in the process.
         self._config_restore: dict = {}
         if system_config:
-            from ray_tpu._private.config import config as global_config
             if isinstance(system_config, str):
                 import json as _json
                 system_config = _json.loads(system_config) \
@@ -1645,13 +1656,27 @@ class _LocalCluster:
         self.session_dir = os.path.join(
             "/tmp", "ray_tpu", f"session_{int(time.time()*1000)}_{os.getpid()}")
         os.makedirs(self.session_dir, exist_ok=True)
-        self.gcs = GcsServer(port=port)
+        self.gcs = None        # in-process GcsServer, or None
+        self.gcs_proc = None   # gcs_launcher.GcsProcess, or None
+        if bool(global_config.gcs_out_of_process):
+            from ray_tpu._private.gcs_launcher import GcsProcess
+
+            # Config (including the system_config just applied) rides
+            # the launcher's --system-config diff to the child.
+            self.gcs_proc = GcsProcess(session_dir=self.session_dir,
+                                       port=port)
+            self.address = self.gcs_proc.address
+        else:
+            from ray_tpu._private.gcs import GcsServer
+
+            self.gcs = GcsServer(port=port)
+            self.address = self.gcs.address
         from ray_tpu._private.node_manager import NodeManager
 
         if num_cpus is None:
             num_cpus = os.cpu_count() or 4
         self.nm = NodeManager(
-            gcs_address=self.gcs.address,
+            gcs_address=self.address,
             session_dir=self.session_dir,
             num_cpus=num_cpus,
             num_tpus=num_tpus or 0,
@@ -1660,7 +1685,6 @@ class _LocalCluster:
             is_head=True,
             node_name="head",
         )
-        self.address = self.gcs.address
 
     def shutdown(self):
         try:
@@ -1668,7 +1692,10 @@ class _LocalCluster:
         except Exception:
             pass
         try:
-            self.gcs.close()
+            if self.gcs_proc is not None:
+                self.gcs_proc.terminate()
+            if self.gcs is not None:
+                self.gcs.close()
         except Exception:
             pass
         if self._config_restore:

@@ -568,6 +568,15 @@ class WorkerExecutor:
             return lambda: True
         if name == "__ray_terminate__":
             return self._terminate_actor
+        if self.actor_instance is None:
+            # A task reached this worker before any create_actor did:
+            # a control-plane routing bug, not a user error — name the
+            # worker so the misrouted hop is attributable.
+            raise AttributeError(
+                f"actor task '{name}' reached worker "
+                f"{self.worker_id.hex()[:12]} (pid {os.getpid()}) before "
+                f"its create_actor (spec "
+                f"{'set' if self.actor_spec is not None else 'unset'})")
         method = getattr(self.actor_instance, name, None)
         if method is None:
             raise AttributeError(

@@ -5,7 +5,10 @@ raylet+plasma processes on one machine; most of the reference's
 
 ``Cluster`` hosts one GCS plus N in-process ``NodeManager`` instances
 (each with its own shm object store and worker subprocess pool), so
-multi-node scheduling, spillback, and failure tests run hostless.
+multi-node scheduling, spillback, and failure tests run hostless. With
+``gcs_out_of_process=True`` (or the config knob) the GCS runs as a real
+subprocess instead — every node manager then reaches it purely by
+address, the same topology ``ray_tpu start --head`` deploys.
 """
 
 from __future__ import annotations
@@ -14,19 +17,35 @@ import os
 import time
 from typing import Dict, List, Optional
 
-from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private import protocol
 from ray_tpu._private.node_manager import NodeManager
 
 
 class Cluster:
     def __init__(self, initialize_head: bool = True,
-                 head_node_args: Optional[dict] = None):
+                 head_node_args: Optional[dict] = None,
+                 gcs_out_of_process: Optional[bool] = None):
+        from ray_tpu._private.config import config
+
         self.session_dir = os.path.join(
             "/tmp", "ray_tpu",
             f"cluster_{int(time.time()*1000)}_{os.getpid()}")
         os.makedirs(self.session_dir, exist_ok=True)
-        self.gcs = GcsServer()
-        self.address = self.gcs.address
+        if gcs_out_of_process is None:
+            gcs_out_of_process = bool(config.gcs_out_of_process)
+        self.gcs = None        # in-process GcsServer, or None
+        self.gcs_proc = None   # gcs_launcher.GcsProcess, or None
+        self._gcs_probe: Optional[protocol.Conn] = None
+        if gcs_out_of_process:
+            from ray_tpu._private.gcs_launcher import GcsProcess
+
+            self.gcs_proc = GcsProcess(session_dir=self.session_dir)
+            self.address = self.gcs_proc.address
+        else:
+            from ray_tpu._private.gcs import GcsServer
+
+            self.gcs = GcsServer()
+            self.address = self.gcs.address
         self.nodes: List[NodeManager] = []
         if initialize_head:
             self.add_node(is_head=True, **(head_node_args or {}))
@@ -58,24 +77,33 @@ class Cluster:
             self.nodes.remove(nm)
         nm.shutdown()
 
+    def _count_alive(self) -> int:
+        """Alive nodes as the GCS sees them, without a driver: peek the
+        in-process server's ledger, or ask the subprocess over its own
+        probe connection (connect-by-address only — no shortcuts)."""
+        if self.gcs is not None:
+            with self.gcs._sched_lock:
+                return sum(1 for n in self.gcs._nodes.values() if n.alive)
+        if self._gcs_probe is None or self._gcs_probe.closed:
+            self._gcs_probe = protocol.connect(
+                self.address, name="cluster-probe", timeout=10)
+        nodes = self._gcs_probe.request("nodes", timeout=10)
+        return sum(1 for n in nodes if n["Alive"])
+
     def wait_for_nodes(self, timeout: float = 30) -> bool:
         """Wait until the GCS sees every added node alive."""
-        import ray_tpu
         from ray_tpu._private import worker as worker_mod
 
         deadline = time.time() + timeout
         while time.time() < deadline:
             w = worker_mod.global_worker()
-            if w is not None:
-                alive = sum(1 for n in w.nodes() if n["Alive"])
-                if alive >= len(self.nodes):
-                    return True
-            else:
-                with self.gcs._sched_lock:
-                    alive = sum(1 for n in self.gcs._nodes.values()
-                                if n.alive)
-                if alive >= len(self.nodes):
-                    return True
+            try:
+                alive = (sum(1 for n in w.nodes() if n["Alive"])
+                         if w is not None else self._count_alive())
+            except Exception:
+                alive = 0
+            if alive >= len(self.nodes):
+                return True
             time.sleep(0.1)
         return False
 
@@ -92,7 +120,16 @@ class Cluster:
             except Exception:
                 pass
         self.nodes.clear()
+        if self._gcs_probe is not None:
+            try:
+                self._gcs_probe.close()
+            except Exception:
+                pass
+            self._gcs_probe = None
         try:
-            self.gcs.close()
+            if self.gcs_proc is not None:
+                self.gcs_proc.terminate()
+            if self.gcs is not None:
+                self.gcs.close()
         except Exception:
             pass

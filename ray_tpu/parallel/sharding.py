@@ -37,7 +37,16 @@ class AxisRules(dict):
                 continue
             ms = (m,) if isinstance(m, str) else tuple(m)
             ms = tuple(x for x in ms if x in mesh.axis_names)
-            parts.append(ms if ms else None)
+            if not ms:
+                parts.append(None)
+            elif len(ms) == 1:
+                # A single surviving mesh axis goes in bare: jax's
+                # PartitionSpec treats ("tp",) and "tp" as distinct
+                # entries, and a rule table written with plain strings
+                # must round-trip through axis filtering unchanged.
+                parts.append(ms[0])
+            else:
+                parts.append(ms)
         return NamedSharding(mesh, PartitionSpec(*parts))
 
 

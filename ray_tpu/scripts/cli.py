@@ -43,7 +43,10 @@ def _connect(address: str | None):
 def cmd_start(args) -> int:
     """Start a standalone head node that outlives this command
     (reference: ``ray start --head`` spawning gcs_server+raylet;
-    services.py:1273)."""
+    services.py:1273). The GCS runs as its OWN subprocess by default
+    (the reference's gcs_server topology — its handler concurrency
+    never competes with the head node manager for one GIL); pass
+    ``--gcs-in-process`` to collapse it back into the head daemon."""
     if os.path.exists(_PID_FILE):
         pid = int(open(_PID_FILE).read())
         try:
@@ -86,7 +89,10 @@ def cmd_start(args) -> int:
     os.close(null_fd)
     os.close(log_fd)
     from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.config import config as _cfg
 
+    if not args.gcs_in_process:
+        _cfg.set("gcs_out_of_process", True)
     cluster = worker_mod._LocalCluster(
         args.num_cpus, args.num_tpus, None,
         args.object_store_memory, None, port=args.port)
@@ -102,6 +108,23 @@ def cmd_start(args) -> int:
     signal.signal(signal.SIGTERM, on_term)
     while not stop["flag"]:
         time.sleep(0.5)
+        if cluster.gcs_proc is not None and not cluster.gcs_proc.alive():
+            # The GCS child died out from under us: respawn it on the
+            # same port with the same session dir — the node manager and
+            # attached drivers redial-with-backoff and recover from
+            # gcs_storage (when durable storage is configured).
+            from ray_tpu._private.gcs_launcher import GcsLaunchError, \
+                GcsProcess
+
+            port = int(cluster.address.rsplit(":", 1)[1])
+            print(f"gcs subprocess died (rc={cluster.gcs_proc.poll()}); "
+                  f"respawning on port {port}", file=sys.stderr)
+            try:
+                cluster.gcs_proc = GcsProcess(
+                    session_dir=cluster.session_dir, port=port)
+            except GcsLaunchError as e:
+                print(f"gcs respawn failed: {e}", file=sys.stderr)
+                break
     cluster.shutdown()
     for p in (_PID_FILE, _ADDR_FILE):
         try:
@@ -308,8 +331,24 @@ def cmd_stop(args) -> int:
     return 0
 
 
+def format_gcs_process_line(stats: dict) -> str:
+    """One-line GCS process health from control_plane_stats."""
+    gp = stats.get("gcs_process") or {}
+    where = "own process" if gp.get("out_of_process") else "in-process"
+    rss = gp.get("rss_bytes")
+    rss_s = f"{rss / (1 << 20):.0f} MiB" if rss else "?"
+    cpu = gp.get("cpu_percent")
+    cpu_s = f"{cpu:g}%" if cpu is not None else "?"
+    return (f"gcs: pid {gp.get('pid', '?')} ({where}) rss {rss_s} "
+            f"cpu {cpu_s} listener-threads "
+            f"{gp.get('listener_threads', '?')} "
+            f"outbox {gp.get('outbox_depth', '?')}")
+
+
 def cmd_status(args) -> int:
     ray_tpu = _connect(args.address)
+    from ray_tpu._private import worker as worker_mod
+
     nodes = ray_tpu.nodes()
     total = ray_tpu.cluster_resources()
     avail = ray_tpu.available_resources()
@@ -317,6 +356,16 @@ def cmd_status(args) -> int:
           f"{len(nodes)} total")
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0):g} / {total[k]:g} available")
+    try:
+        stats = worker_mod.require_worker().gcs.request(
+            "control_plane_stats", timeout=30)
+        print(format_gcs_process_line(stats))
+        print(f"control plane: {stats.get('queued_tasks', 0)} queued / "
+              f"{stats.get('running_tasks', 0)} running tasks, "
+              f"{stats.get('actors', 0)} actors, "
+              f"{stats.get('leases', 0)} leases")
+    except Exception as e:
+        print(f"gcs: stats unavailable ({e})", file=sys.stderr)
     ray_tpu.shutdown()
     return 0
 
@@ -411,6 +460,10 @@ def main(argv=None) -> int:
     p.add_argument("--num-tpus", type=int, default=None)
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--gcs-in-process", action="store_true",
+                   help="run the GCS inside the head daemon instead of "
+                        "as its own subprocess (the pre-SCALE_r07 "
+                        "topology)")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("stop")

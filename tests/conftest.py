@@ -63,10 +63,22 @@ def _lockdep_env_enabled() -> bool:
 
 def pytest_runtest_setup(item):
     mod = getattr(item.module, "__name__", "")
-    if mod in LOCKDEP_MODULES or _lockdep_env_enabled():
+    lockdep_on = mod in LOCKDEP_MODULES or _lockdep_env_enabled()
+    if lockdep_on:
         from ray_tpu._private import lockdep
 
         lockdep.install()
+    # Out-of-process control-plane children spawned by lockdep-module
+    # tests (the `python -m ray_tpu._private.gcs` entrypoint) run
+    # lockdep too: the knob rides the launcher's --system-config diff,
+    # and the entrypoint exits rc=3 if its serve/shutdown path witnessed
+    # an ordering cycle. The knob is re-set per test (the registry is
+    # process-global) so children of NON-lockdep tests don't inherit it
+    # — in-process install stays session-sticky by design, but child
+    # semantics must not leak across modules.
+    from ray_tpu._private.config import config
+
+    config.set("lockdep_enabled", lockdep_on)
 
 
 @pytest.fixture(autouse=True)

@@ -3,14 +3,25 @@
 Reference parity targets: redis_store_client.h:28 (durable GCS tables),
 GcsInitData restore at server start, raylet re-registration after GCS
 failover, and gcs_health_check_manager.h:39 (active liveness checks).
+
+Two tiers: the in-process ``GcsServer`` with ``crash_for_test`` (fast;
+most cases), and the REAL out-of-process GCS subprocess
+(``gcs_launcher.GcsProcess``) SIGKILLed mid-workload — the topology
+``ray_tpu start --head`` actually deploys.
 """
 
+import os
+import signal
+import threading
 import time
 
 import pytest
 
 import ray_tpu
+from ray_tpu._private import lockdep, protocol
+from ray_tpu._private.config import config
 from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.gcs_launcher import GcsProcess
 from ray_tpu._private.node_manager import NodeManager
 
 
@@ -120,6 +131,201 @@ def test_gcs_restart_task_submission_works(external_cluster):
                     for n in worker_mod.require_worker().nodes()),
         msg="node rejoined restarted gcs")
     assert ray_tpu.get(add.remote(40, 2), timeout=30) == 42
+
+
+# ------------------------------------------- real out-of-process GCS
+
+
+@pytest.fixture
+def subprocess_cluster(tmp_path):
+    """The REAL split topology: GCS as its own subprocess (own
+    interpreter/GIL) with durable storage, one NodeManager and the
+    driver attached purely by address."""
+    storage = str(tmp_path / "gcs.db")
+    session = str(tmp_path / "session")
+    gcs_proc = GcsProcess(session_dir=session, storage_path=storage)
+    nm = NodeManager(
+        gcs_address=gcs_proc.address,
+        session_dir=session,
+        num_cpus=2, num_tpus=0, resources=None,
+        object_store_memory=64 * 1024 * 1024,
+        is_head=True, node_name="head")
+    ray_tpu.init(address=gcs_proc.address)
+    state = {"gcs_proc": gcs_proc, "nm": nm, "storage": storage,
+             "session": session}
+    yield state
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    try:
+        state["nm"].shutdown()
+    except Exception:
+        pass
+    try:
+        state["gcs_proc"].terminate()
+    except Exception:
+        pass
+
+
+class _SlowCounter:
+    def __init__(self):
+        self.n = 0
+
+    def incr(self):
+        self.n += 1
+        return self.n
+
+    def slow(self, delay):
+        time.sleep(delay)
+        return "done"
+
+
+def test_gcs_subprocess_sigkill_mid_workload_recovers(subprocess_cluster):
+    """SIGKILL the real GCS process with an actor-task ray.get in
+    flight; restart it on the same port from the same gcs_storage. The
+    NM redials and re-registers, the driver channel redials on its next
+    call, the in-flight get COMPLETES, and durable state (KV, detached
+    named actor — same process, not a restarted one) survives."""
+    st = subprocess_cluster
+    from ray_tpu._private import worker as worker_mod
+
+    cls = ray_tpu.remote(_SlowCounter)
+    c = cls.options(name="ctr", lifetime="detached").remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=30) == 1
+    kv = worker_mod.require_worker().kv()
+    kv.put(b"survives", b"yes")
+
+    # In-flight get across the kill: the actor task takes ~4s; the GCS
+    # dies ~0.5s in and comes back ~2s in.
+    ref = c.slow.remote(4.0)
+    result = {}
+
+    def bg_get():
+        t0 = time.time()
+        try:
+            result["value"] = ray_tpu.get(ref, timeout=90)
+        except BaseException as e:  # surfaced to the assert below
+            result["error"] = e
+        result["elapsed"] = time.time() - t0
+
+    th = threading.Thread(target=bg_get)
+    th.start()
+    time.sleep(0.5)
+
+    port = int(st["gcs_proc"].address.rsplit(":", 1)[1])
+    os.kill(st["gcs_proc"].pid, signal.SIGKILL)
+    st["gcs_proc"].proc.wait(timeout=30)
+    time.sleep(1.0)
+    st["gcs_proc"] = GcsProcess(session_dir=st["session"], port=port,
+                                storage_path=st["storage"])
+
+    # NM redial + re-registration against the restarted process.
+    _wait_until(
+        lambda: any(n["Alive"]
+                    for n in worker_mod.require_worker().nodes()),
+        msg="node rejoined restarted gcs subprocess")
+
+    # The in-flight get completed (bounded by its own timeout, which it
+    # must come in far under).
+    th.join(timeout=90)
+    assert not th.is_alive(), "in-flight get hung across the GCS kill"
+    assert result.get("value") == "done", result.get("error")
+    assert result["elapsed"] < 60
+
+    # Durable state recovered from gcs_storage.
+    assert kv.get(b"survives") == b"yes"
+    h = ray_tpu.get_actor("ctr")
+    assert ray_tpu.get(h.incr.remote(), timeout=30) == 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(40, 2), timeout=30) == 42
+
+
+def test_gcs_subprocess_dead_typed_error_within_rpc_timeout(
+        subprocess_cluster):
+    """GCS SIGKILLed and NOT restarted: control RPCs and in-flight gets
+    fail with a typed error within ~gcs_rpc_timeout_s — never a hang."""
+    st = subprocess_cluster
+    from ray_tpu import exceptions
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.worker import ObjectRef
+
+    w = worker_mod.require_worker()
+    assert w.kv().put(b"a", b"b")
+    old_timeout = config.gcs_rpc_timeout_s
+    config.set("gcs_rpc_timeout_s", 5.0)
+    try:
+        st["gcs_proc"].kill()
+        typed = (ConnectionError, protocol.ConnectionClosed, OSError,
+                 TimeoutError, exceptions.GetTimeoutError)
+
+        t0 = time.time()
+        with pytest.raises(typed):
+            w.kv().get(b"a")
+        assert time.time() - t0 < 3 * 5.0
+
+        # An in-flight get of an object the dead GCS would have to
+        # resolve: typed failure, bounded.
+        ref = ObjectRef(ObjectID.from_random())
+        t0 = time.time()
+        with pytest.raises(typed):
+            ray_tpu.get(ref, timeout=3)
+        assert time.time() - t0 < 3 * 5.0
+    finally:
+        config.set("gcs_rpc_timeout_s", old_timeout)
+
+
+# ------------------------------- lockdep over the bootstrap/serve loop
+
+
+def test_blocking_region_guard_detects_held_lock():
+    """The runtime guard the launcher plants before child-process waits:
+    entering a blocking region while holding a tracked lock is recorded
+    as a violation."""
+    lk = lockdep.tracked(key="test_gcs_ft:guard-probe")
+    with lk:
+        lockdep.note_blocking_region("probe")
+    found = lockdep.take_violations()
+    assert len(found) == 1
+    assert "blocking:probe" in str(found[0])
+    assert "guard-probe" in str(found[0])
+
+
+def test_gcs_bootstrap_shutdown_takes_no_shard_lock(tmp_path):
+    """Regression fixture for the split: spawn the GCS entrypoint with
+    lockdep enabled IN THE CHILD (shipped via the config diff), drive
+    its serve loop, and tear it down gracefully. The parent-side
+    bootstrap/terminate waits run under the note_blocking_region guard
+    (the module-level autouse fixture asserts no violation), and the
+    child asserts its own serve/shutdown path witnessed no lock-order
+    cycle — a violated child exits rc=3, so rc==0 IS the assertion."""
+    old = config.lockdep_enabled
+    config.set("lockdep_enabled", True)
+    try:
+        gcs_proc = GcsProcess(session_dir=str(tmp_path / "session"))
+        conn = protocol.connect(gcs_proc.address, name="lockdep-probe",
+                                timeout=10)
+        try:
+            assert conn.request("kv_put", {
+                "ns": "", "key": b"k", "value": b"v"}, timeout=10)
+            assert conn.request("kv_get", {"ns": "", "key": b"k"},
+                                timeout=10) == b"v"
+            stats = conn.request("control_plane_stats", timeout=10)
+            assert stats["gcs_process"]["out_of_process"] is True
+            assert stats["gcs_process"]["pid"] == gcs_proc.pid
+        finally:
+            conn.close()
+        rc = gcs_proc.terminate(timeout=30)
+        assert rc == 0, (
+            f"gcs child exited rc={rc}: lockdep witnessed a violation "
+            f"in the serve/shutdown path (rc=3) or the drain failed")
+    finally:
+        config.set("lockdep_enabled", old)
 
 
 def test_health_check_marks_wedged_node_dead(tmp_path):

@@ -64,7 +64,7 @@ def test_axis_rules_spec_and_sharding():
     # Rules naming absent mesh axes degrade to replication on that dim.
     mesh_dp = make_mesh(axes={"dp": 8})
     sh2 = rules.sharding(mesh_dp, "batch", "mlp")
-    assert sh2.spec == P(("dp",), None)
+    assert sh2.spec == P("dp", None)
 
 
 def test_shard_pytree():
@@ -73,7 +73,7 @@ def test_shard_pytree():
     axes = {"w": ("batch", "mlp"), "b": None}
     rules = AxisRules(batch="dp", mlp="tp")
     out = shard_pytree(tree, mesh, axes, rules)
-    assert out["w"].sharding.spec == jax.sharding.PartitionSpec(("dp",), ("tp",))
+    assert out["w"].sharding.spec == jax.sharding.PartitionSpec("dp", "tp")
     np.testing.assert_array_equal(np.asarray(out["w"]), tree["w"])
 
 
