@@ -33,6 +33,7 @@ import json
 import os
 import random
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -426,11 +427,246 @@ def run_handle_ab(args):
     }
 
 
+# ----------------------------------------------------------- open loop
+
+
+def _proxy_port():
+    import ray_tpu
+    from ray_tpu.serve.api import _controller
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        ports = ray_tpu.get(_controller().proxy_addresses.remote(),
+                            timeout=10)
+        if ports:
+            return next(iter(ports.values()))
+        time.sleep(0.3)
+    raise RuntimeError("ingress proxy never came up")
+
+
+def _sse_request(port, payload, headers, rec):
+    """One open-loop request over SSE; fills ``rec`` in place."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"}, **headers))
+    t0 = time.perf_counter()
+    try:
+        resp = urllib.request.urlopen(req, timeout=120)
+    except urllib.error.HTTPError as e:
+        rec["status"] = e.code
+        rec["t_done"] = time.perf_counter() - t0
+        return
+    except Exception:
+        rec["status"] = -1
+        rec["t_done"] = time.perf_counter() - t0
+        return
+    rec["status"] = resp.status
+    buf = b""
+    t_prev = None
+    try:
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                for line in frame.split(b"\n"):
+                    if not line.startswith(b"data: "):
+                        continue
+                    data = line[len(b"data: "):]
+                    now = time.perf_counter()
+                    if data == b"[DONE]":
+                        rec["t_done"] = now - t0
+                        return
+                    n_toks = len(json.loads(data)["choices"][0]["tokens"])
+                    if rec.get("ttft") is None:
+                        rec["ttft"] = now - t0
+                    elif n_toks:
+                        rec.setdefault("gaps", []).extend(
+                            [(now - t_prev) / n_toks] * n_toks)
+                    t_prev = now
+                    rec["tokens"] = rec.get("tokens", 0) + n_toks
+    except Exception:
+        rec["status"] = -2
+    finally:
+        resp.close()
+        rec.setdefault("t_done", time.perf_counter() - t0)
+
+
+def run_open_loop(args):
+    """Open-loop SLO bench: Poisson arrivals through the HTTP/SSE
+    ingress at a RISING rate ladder, per-tenant, reporting p50/p99
+    TTFT + per-token latency of ADMITTED requests and the shed rate —
+    the graceful-saturation curve (shed rises past the knee; admitted
+    tail latency stays bounded; no collapse)."""
+    port = _proxy_port()
+    rng = random.Random(1234)
+    tenants = [f"tenant{i}" for i in range(max(1, args.tenants))]
+    rungs = []
+    for rate in [float(r) for r in args.open_loop_rates.split(",")]:
+        records = []
+        threads = []
+        t_end = time.perf_counter() + args.rung_duration
+        i = 0
+        while time.perf_counter() < t_end:
+            # Poisson arrivals: exponential inter-arrival gaps.
+            time.sleep(rng.expovariate(rate))
+            tenant = tenants[i % len(tenants)]
+            i += 1
+            rec = {"tenant": tenant, "ttft": None}
+            records.append(rec)
+            payload = {"model": "llm",
+                       "prompt": [rng.randint(1, 200) for _ in
+                                  range(rng.randint(4, 12))],
+                       "max_tokens": args.new_tokens, "stream": True,
+                       "seed": i}
+            th = threading.Thread(
+                target=_sse_request, args=(port, payload,
+                                           {"x-tenant": tenant}, rec))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=180)
+        ok = [r for r in records if r.get("status") == 200]
+        shed = [r for r in records if r.get("status") in (429, 503)]
+        errors = [r for r in records
+                  if r.get("status") not in (200, 429, 503)]
+        per_tenant = {}
+        for t in tenants:
+            t_ok = [r for r in ok if r["tenant"] == t]
+            t_all = [r for r in records if r["tenant"] == t]
+            per_tenant[t] = {
+                "offered": len(t_all), "completed": len(t_ok),
+                "ttft_s": _percentiles(
+                    [r["ttft"] for r in t_ok if r["ttft"]],
+                    ps=(50, 95, 99)),
+            }
+        rungs.append({
+            "offered_rps": rate,
+            "observed_rps": round(len(records) / args.rung_duration, 2),
+            "requests": len(records),
+            "completed": len(ok),
+            "shed": len(shed),
+            "errors": len(errors),
+            "shed_rate": round(len(shed) / max(1, len(records)), 3),
+            "ttft_s": _percentiles(
+                [r["ttft"] for r in ok if r["ttft"] is not None],
+                ps=(50, 95, 99)),
+            "per_token_s": _percentiles(
+                [g for r in ok for g in r.get("gaps", [])],
+                ps=(50, 95, 99)),
+            "request_latency_s": _percentiles(
+                [r["t_done"] for r in ok if "t_done" in r],
+                ps=(50, 95, 99)),
+            "tokens": sum(r.get("tokens", 0) for r in ok),
+            "per_tenant": per_tenant,
+        })
+        print(json.dumps({"rung": rungs[-1]}), flush=True)
+    # Graceful saturation: the LAST rung must shed (we pushed past the
+    # knee) while admitted p99 TTFT stays within the bound.
+    admitted_p99 = [r["ttft_s"]["p99"] for r in rungs
+                    if r["ttft_s"]["p99"] is not None]
+    return {
+        "metric": "llm_serve_open_loop",
+        "engine": "paged" if args.paged else "reserved",
+        "new_tokens": args.new_tokens,
+        "tenants": len(tenants),
+        "rungs": rungs,
+        "saturation": {
+            "sheds_at_peak": rungs[-1]["shed"] if rungs else 0,
+            "shed_rate_curve": [r["shed_rate"] for r in rungs],
+            "admitted_p99_ttft_curve": admitted_p99,
+            "graceful": bool(rungs) and rungs[-1]["shed"] > 0 and
+            max(admitted_p99 or [0]) <
+            float(args.ttft_slo_s),
+        },
+    }
+
+
+def run_long_context(args):
+    """The memory-side unlock, measured: under ONE KV byte budget the
+    reserved (max_len-reservation) engine cannot even construct — the
+    typed OOM boundary — while the paged engine admits and serves a
+    long context, with block-pool occupancy recorded during the run."""
+    import jax
+
+    from ray_tpu.exceptions import KVCacheExhaustedError
+    from ray_tpu.serve.llm import EngineConfig, InflightBatchEngine
+    from ray_tpu.serve.llm.replicas import _build_model
+
+    max_len = args.long_context_len
+    base = dict(
+        preset="llama-tiny",
+        model_overrides={"n_layers": 2, "d_model": 256, "n_heads": 8,
+                         "d_ff": 768, "dtype": "float32",
+                         "max_seq": max_len},
+        max_slots=8, max_len=max_len, prompt_buckets=(16,),
+        max_new_tokens=64)
+    probe = EngineConfig.from_dict(base)
+    per_tok = probe.kv_bytes_per_token()
+    # Budget: HALF the reserved layout's up-front demand — a budget a
+    # real device plausibly has. Reserved needs slots*max_len rows NOW;
+    # paged only pages for live tokens.
+    reserved_need = base["max_slots"] * max_len * per_tok
+    budget = reserved_need // 2
+    cfg, params = _build_model(probe)
+
+    reserved_error = None
+    try:
+        InflightBatchEngine(params, cfg, EngineConfig.from_dict(
+            dict(base, max_kv_bytes=budget)))
+    except KVCacheExhaustedError as e:
+        reserved_error = str(e)
+
+    bs = 16
+    nb = budget // (bs * per_tok)
+    eng = InflightBatchEngine(params, cfg, EngineConfig.from_dict(
+        dict(base, paged_kv=True, kv_block_size=bs,
+             kv_num_blocks=int(nb), prefill_chunk=32,
+             max_kv_bytes=budget)))
+    occupancy = []
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            occupancy.append(eng.stats()["kv_block_occupancy"])
+            time.sleep(0.05)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+    prompt = [1 + (i % 200) for i in range(args.long_context_prompt)]
+    t0 = time.perf_counter()
+    out = eng.generate(prompt, 48)
+    wall = time.perf_counter() - t0
+    stop.set()
+    sampler.join(timeout=5)
+    stats = eng.stats()
+    eng.stop()
+    return {
+        "metric": "llm_long_context_paged_vs_reserved",
+        "kv_budget_bytes": int(budget),
+        "reserved_need_bytes": int(reserved_need),
+        "reserved_oom": reserved_error is not None,
+        "reserved_error": reserved_error,
+        "paged_prompt_len": len(prompt),
+        "paged_tokens_out": len(out),
+        "paged_wall_s": round(wall, 2),
+        "kv_block_occupancy_peak": max(occupancy or [0]),
+        "kv_blocks_total": stats["kv_blocks_total"],
+        "no_block_leak": stats["kv_blocks_used"] == 0,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="all",
                     choices=["all", "engine", "baseline", "probe",
-                             "handle-ab"])
+                             "handle-ab", "open-loop", "long-context"])
     ap.add_argument("--sessions", type=int, default=1000)
     ap.add_argument("--duration", type=float, default=15.0,
                     help="load-phase seconds per mode")
@@ -449,18 +685,67 @@ def main():
                          "static replicas (0 = autoscaled like the "
                          "engine pool)")
     ap.add_argument("--num-tpus-per-replica", type=int, default=0)
+    # --- open-loop SLO bench -------------------------------------------
+    ap.add_argument("--open-loop-rates", default="2,4,8,16,32,64",
+                    help="rising offered-rate ladder (requests/s)")
+    ap.add_argument("--rung-duration", type=float, default=10.0)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--paged", action="store_true", default=True)
+    ap.add_argument("--no-paged", dest="paged", action="store_false",
+                    help="A/B: reserved max_len KV instead of paged")
+    ap.add_argument("--ttft-slo-s", type=float, default=5.0,
+                    help="admitted-request p99 TTFT bound for the "
+                         "graceful-saturation verdict")
+    ap.add_argument("--http-port", type=int, default=18640)
+    ap.add_argument("--long-context-len", type=int, default=1024)
+    ap.add_argument("--long-context-prompt", type=int, default=700)
+    ap.add_argument("--out", default="",
+                    help="write all result records to this JSON file")
     args = ap.parse_args()
 
     import ray_tpu
     from ray_tpu import serve
     from ray_tpu.serve.llm import build_llm_app
 
-    ray_tpu.init(num_cpus=8, object_store_memory=512 * 1024 * 1024)
-    serve.start(http_port=None)
+    open_loop = args.mode in ("all", "open-loop")
+    ray_tpu.init(num_cpus=8, object_store_memory=512 * 1024 * 1024,
+                 _system_config={
+                     # Admit roughly what the engine can HOLD at
+                     # bounded TTFT (slots + ~1 wave of queue); streams
+                     # each occupy one pump thread for their life, so
+                     # the executor must cover max_inflight.
+                     "serve_ingress_max_inflight": 40,
+                     "serve_ingress_queue_watermark": 16,
+                     "serve_ingress_queue_timeout_s": 1.5,
+                     "serve_ingress_executor_threads": 64,
+                 } if open_loop else None)
+    serve.start(http_port=args.http_port if open_loop else None)
     results = []
     opts = {"num_tpus": args.num_tpus_per_replica} \
         if args.num_tpus_per_replica else None
     try:
+        if args.mode in ("all", "long-context"):
+            results.append(run_long_context(args))
+            print(json.dumps(results[-1]), flush=True)
+
+        if open_loop:
+            ecfg = dict(_engine_config(args),
+                        max_queue=256)
+            if args.paged:
+                ecfg.update(paged_kv=True, kv_block_size=16,
+                            prefill_chunk=16)
+            handle = serve.run(
+                build_llm_app(ecfg, mode="combined", name="llm",
+                              autoscaling_config=None,
+                              num_replicas=1,
+                              ray_actor_options=opts),
+                route_prefix="/llm")
+            handle.remote({"prompt": [1, 2, 3],
+                           "n": args.new_tokens}).result(timeout=600)
+            results.append(run_open_loop(args))
+            print(json.dumps(results[-1]), flush=True)
+            serve.delete("llm")
+            serve.delete(ENGINE_POOL)
         if args.mode in ("all", "probe"):
             results.append(run_handoff_probe(args))
             print(json.dumps(results[-1]), flush=True)
@@ -548,6 +833,10 @@ def main():
                 "speedup": round(eng["tokens_per_sec"] /
                                  max(base["tokens_per_sec"], 1e-9), 2),
             }), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"bench": "llm_serve", "results": results},
+                          f, indent=1)
     finally:
         serve.shutdown()
         ray_tpu.shutdown()

@@ -372,6 +372,50 @@ _d("serve_handle_stats_rpc", False,
    "RPCs. Kept as the A/B baseline for the routing microbench. "
    "Env: RAY_TPU_SERVE_HANDLE_STATS_RPC.")
 
+# --- serve ingress (HTTP/SSE front door) ------------------------------------
+_d("serve_ingress_max_inflight", 256,
+   "Per-proxy concurrency budget: requests admitted past the front door "
+   "and not yet answered (streams count until their last SSE frame). "
+   "Arrivals beyond it wait in per-tenant queues served deficit-round-"
+   "robin. Size it to what one proxy's downstream replicas can hold "
+   "in flight; the watermark below bounds the waiting room.")
+_d("serve_ingress_queue_watermark", 128,
+   "Waiting-room high watermark: arrivals that would push the admission "
+   "queue past this are SHED immediately with 429 + Retry-After "
+   "(typed ServeOverloadedError) instead of building an unbounded "
+   "backlog in front of saturated replicas — the graceful-saturation "
+   "contract the open-loop bench measures.")
+_d("serve_ingress_queue_timeout_s", 10.0,
+   "Longest a request may wait in the admission queue before it is shed "
+   "with 503 (it was admitted to the waiting room but never won a "
+   "slot): bounds client-perceived queueing delay under sustained "
+   "overload.")
+_d("serve_ingress_executor_threads", 32,
+   "Headroom threads of the proxy's dedicated data-plane pool (the old "
+   "data path ran every request on the asyncio DEFAULT executor and "
+   "exhausted it under load). The pool is sized max_inflight + this: "
+   "admitted streams each hold one pump thread for their lifetime "
+   "(covered by the max_inflight share), and this margin keeps "
+   "short-lived calls — route resolution, stream opens, non-streaming "
+   "requests — from queueing behind a full house of streams.")
+_d("serve_ingress_tenant_header", "x-tenant",
+   "HTTP header naming the tenant for fair admission; absent means the "
+   "shared 'default' tenant.")
+_d("serve_ingress_tenant_rate", 0.0,
+   "Per-tenant token-bucket refill (requests/second) at the ingress; "
+   "0 disables rate limiting (fairness then comes only from "
+   "deficit-round-robin queue service).")
+_d("serve_ingress_tenant_burst", 16.0,
+   "Per-tenant token-bucket capacity (burst size) when "
+   "serve_ingress_tenant_rate is set.")
+_d("serve_ingress_request_timeout_s", 120.0,
+   "Bound on one non-streaming proxy->handle call (maps to 503, not a "
+   "parked proxy thread).")
+_d("serve_ingress_stream_item_timeout_s", 120.0,
+   "Bound on EACH item pull of a streaming (SSE) response; a wedged "
+   "replica generator surfaces as a terminated stream, not a "
+   "forever-open socket.")
+
 # --- correctness tooling ----------------------------------------------------
 _d("lockdep_enabled", False,
    "Runtime lock-order witness (ray_tpu._private.lockdep): wrap every "

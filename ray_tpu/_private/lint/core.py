@@ -52,6 +52,12 @@ CONTROL_PLANE = (
     "ray_tpu/serve/llm/replicas.py",
     "ray_tpu/serve/llm/router.py",
     "ray_tpu/serve/llm/kv_transfer.py",
+    "ray_tpu/serve/llm/paged.py",
+    # The HTTP ingress: every ingress->handle hop must be bounded — a
+    # parked proxy thread is one of a BOUNDED pool, so an unbounded
+    # wait doesn't just wedge one request, it shrinks the front door.
+    "ray_tpu/serve/ingress/server.py",
+    "ray_tpu/serve/ingress/admission.py",
 )
 
 # The subset where a swallowed GangMemberDiedError / RayActorError turns

@@ -131,3 +131,25 @@ class OutOfMemoryError(RayTpuError):
 
 class PendingCallsLimitExceeded(RayTpuError):
     """Actor's pending call queue exceeded max_pending_calls."""
+
+
+class ServeOverloadedError(RayTpuError):
+    """A serving-tier admission bound was hit (ingress watermark,
+    tenant rate limit, or engine queue cap): the request was SHED, not
+    failed — the caller should back off ``retry_after_s`` and retry.
+    The HTTP ingress maps this to ``429 Too Many Requests`` with a
+    ``Retry-After`` header instead of a generic 500."""
+
+    def __init__(self, message: str = "serving tier overloaded", *,
+                 retry_after_s: float = 1.0, reason: str = ""):
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+        super().__init__(message)
+
+
+class KVCacheExhaustedError(RayTpuError):
+    """The paged KV block pool (or the engine's KV byte budget) cannot
+    hold this sequence: prompt + generation budget needs more blocks
+    than the whole pool owns. Raised at ADMISSION — a clean, typed
+    failure instead of an OOM mid-generation."""
+

@@ -357,6 +357,263 @@ def decode_step(params: Params, cache: Dict[str, Any], tokens: jax.Array,
     return nxt, {"k": cache["k"], "v": cache["v"], "lengths": new_lengths}
 
 
+# ---------------------------------------------------------------------------
+# Paged (block-granular) KV cache
+#
+# The slotted batch above still reserves ``max_len`` KV rows per slot up
+# front — a 64-token chat in a 4096-token engine pins 4096 rows of cache
+# for its whole life, and the engine's memory ceiling is
+# ``slots x max_len`` whether or not anyone sends long prompts. The paged
+# layout (vLLM's PagedAttention shape) replaces the per-slot reservation
+# with a SHARED pool of fixed-size blocks plus a per-slot block table:
+#
+# - ``init_paged_pool``     — one flat [L, num_blocks*block_size, H, Dh]
+#                             K/V pool + [slots, max_blocks] block tables.
+# - ``prefill_chunk_paged`` — run ONE CHUNK of one prompt through the
+#                             network against the slot's pages (chunked
+#                             prefill: a long prompt is many small calls
+#                             the engine interleaves with decode steps,
+#                             so prefill never stalls the decode batch).
+# - ``adopt_slot_paged``    — scatter a contiguous prefill KV block
+#                             (the disaggregated handoff format) into a
+#                             slot's pages.
+# - ``decode_step_paged``   — one token for every slot, gathering each
+#                             slot's logical context through its block
+#                             table.
+#
+# Conventions: BLOCK 0 IS SCRATCH — the allocator never hands it out,
+# retired slots' tables point at it, and pad-position writes are
+# redirected to it, so a freed slot's stale table can never corrupt a
+# block that was reassigned to another sequence. Unallocated block-table
+# entries are 0 for the same reason. Logical order is block-table order:
+# position ``p`` of a slot lives at pool row
+# ``table[p // bs] * bs + p % bs``.
+
+
+def init_paged_pool(cfg: GPTConfig, num_blocks: int, block_size: int,
+                    slots: int, max_blocks_per_slot: int) -> Dict[str, Any]:
+    """Shared K/V block pool + per-slot block tables. Block 0 is the
+    scratch block (see module comment); per-slot capacity is
+    ``max_blocks_per_slot * block_size`` logical positions."""
+    L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
+    return {
+        "k": jnp.zeros((L, num_blocks * block_size, H, Dh), cfg.dtype),
+        "v": jnp.zeros((L, num_blocks * block_size, H, Dh), cfg.dtype),
+        "block_tables": jnp.zeros((slots, max_blocks_per_slot),
+                                  jnp.int32),
+        "lengths": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def _block_decode_paged(x, bp, layer_cache, lengths, pos, wp,
+                        cfg: GPTConfig):
+    """One block over one new token per slot against the paged pool.
+    ``pos`` [S, T] maps each slot's logical positions to pool rows;
+    ``wp`` [S] is each slot's write row (scratch for inactive slots)."""
+    cd = cfg.dtype
+    scale = cfg.head_dim ** -0.5
+
+    h = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], cfg.eps)
+    qkv = jnp.einsum("bld,dshk->blshk", h, bp["wqkv"].astype(cd)) + \
+        bp["bqkv"].astype(cd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if cfg.rotary:
+        positions = lengths[:, None]                          # [S, 1]
+        q = _rope_batched(q, positions)
+        k = _rope_batched(k, positions)
+    k_pool, v_pool = layer_cache                              # [P, H, Dh]
+    k_pool = k_pool.at[wp].set(k[:, 0].astype(k_pool.dtype))
+    v_pool = v_pool.at[wp].set(v[:, 0].astype(v_pool.dtype))
+    k_ctx = jnp.take(k_pool, pos, axis=0)                     # [S, T, H, Dh]
+    v_ctx = jnp.take(v_pool, pos, axis=0)
+    attn = _attn_slotted(q, k_ctx, v_ctx, lengths, scale)
+    proj = jnp.einsum("blhk,hkd->bld", attn, bp["wo"].astype(cd)) + \
+        bp["bo"].astype(cd)
+    x = x + proj
+
+    from ray_tpu.models.transformer import _ffn
+
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"], cfg.eps)
+    down = _ffn(h, bp, cfg, lambda y, *a: y)
+    return x + down, k_pool, v_pool
+
+
+@functools.partial(jax.jit, donate_argnums=(1,), static_argnames=(
+    "cfg", "block_size", "temperature", "top_k"))
+def decode_step_paged(params: Params, cache: Dict[str, Any],
+                      tokens: jax.Array, active: jax.Array,
+                      seeds: jax.Array, *, cfg: GPTConfig,
+                      block_size: int, temperature: float = 0.0,
+                      top_k: int = 0) -> Tuple[jax.Array, Dict[str, Any]]:
+    """One decode step for the whole paged batch — the paged twin of
+    ``decode_step``: same per-slot lengths/masks/sampling, but each
+    slot's context is gathered through its block table and the new K/V
+    row is scattered to its current page (inactive slots write to the
+    scratch block). The pool is donated — in place where XLA aliases."""
+    cd = cfg.dtype
+    bt = cache["block_tables"]                                # [S, M]
+    lengths = cache["lengths"]                                # [S]
+    S, M = bt.shape
+    bs = block_size
+    pos = (bt[:, :, None] * bs +
+           jnp.arange(bs)[None, None, :]).reshape(S, M * bs)  # [S, T]
+    # Write row of each slot's next token; inactive slots (zeroed table +
+    # length) resolve to the scratch block.
+    wp = jnp.take_along_axis(bt, (lengths // bs)[:, None],
+                             axis=1)[:, 0] * bs + lengths % bs
+    wp = jnp.where(active, wp, 0)
+
+    x = jnp.take(params["tok_embed"], tokens[:, None], axis=0).astype(cd)
+    if not cfg.rotary:
+        x = x + jnp.take(params["pos_embed"], lengths,
+                         axis=0)[:, None].astype(cd)
+
+    def scan_body(carry, inputs):
+        bp, (kc, vc) = inputs
+        out, nk, nv = _block_decode_paged(carry, bp, (kc, vc), lengths,
+                                          pos, wp, cfg)
+        return out, (nk, nv)
+
+    x, (new_k, new_v) = lax.scan(
+        scan_body, x, (params["blocks"], (cache["k"], cache["v"])))
+
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.eps)
+    logits = jnp.einsum("bd,vd->bv", x[:, 0].astype(jnp.float32),
+                        params["tok_embed"].astype(jnp.float32))
+    new_lengths = lengths + active.astype(jnp.int32)
+    nxt = jax.vmap(
+        lambda lg, sd, ctr: _sample_one(lg, sd, ctr, temperature, top_k)
+    )(logits, seeds, new_lengths)
+    return nxt, {"k": new_k, "v": new_v, "block_tables": bt,
+                 "lengths": new_lengths}
+
+
+def _chunk_flat_positions(block_table: jax.Array, logical: jax.Array,
+                          real: jax.Array, block_size: int) -> jax.Array:
+    """Pool rows for logical positions; entries where ``real`` is False
+    (pad) are redirected to the scratch block so a pad write can never
+    land on a page that holds live tokens (clipped out-of-range table
+    reads would otherwise alias the slot's LAST page)."""
+    flat = jnp.take(block_table, logical // block_size,
+                    mode="clip") * block_size + logical % block_size
+    return jnp.where(real, flat, 0)
+
+
+@functools.partial(jax.jit, donate_argnums=(1,), static_argnames=(
+    "cfg", "block_size", "temperature", "top_k"))
+def prefill_chunk_paged(params: Params, pool: Dict[str, Any],
+                        block_table: jax.Array, tokens: jax.Array,
+                        start: jax.Array, chunk_len: jax.Array,
+                        seed: jax.Array, *, cfg: GPTConfig,
+                        block_size: int, temperature: float = 0.0,
+                        top_k: int = 0) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Run ONE CHUNK of one prompt against a slot's pages: tokens
+    [1, C] hold positions [start, start+chunk_len) of the prompt (the
+    tail past ``chunk_len`` is pad), attention sees the slot's earlier
+    pages plus the causal prefix of the chunk, and the chunk's K/V rows
+    are scattered into the slot's pages. Returns (sampled next token
+    [1] — meaningful on the FINAL chunk, where it is the sequence's
+    first generated token, sampled at the same per-request counter the
+    decode path uses — and the updated pool {"k","v"}).
+
+    Compiles once per (chunk length, table width, cfg) — a long prompt
+    is many cheap calls the engine interleaves with decode steps."""
+    cd = cfg.dtype
+    b, C = tokens.shape
+    M = block_table.shape[0]
+    bs = block_size
+    logical = start + jnp.arange(C)                           # [C]
+    real = jnp.arange(C) < chunk_len
+    flat = _chunk_flat_positions(block_table, logical, real, bs)
+    pos_map = (block_table[:, None] * bs +
+               jnp.arange(bs)[None, :]).reshape(M * bs)       # [T]
+
+    x = jnp.take(params["tok_embed"], tokens, axis=0).astype(cd)
+    if not cfg.rotary:
+        x = x + jnp.take(params["pos_embed"], logical,
+                         axis=0)[None].astype(cd)
+
+    scale = cfg.head_dim ** -0.5
+
+    def one_block(xx, bp, kc, vc):
+        h = _layer_norm(xx, bp["ln1_scale"], bp["ln1_bias"], cfg.eps)
+        qkv = jnp.einsum("bld,dshk->blshk", h, bp["wqkv"].astype(cd)) + \
+            bp["bqkv"].astype(cd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.rotary:
+            q = _rope(q, logical)
+            k = _rope(k, logical)
+        kc = kc.at[flat].set(k[0].astype(kc.dtype))
+        vc = vc.at[flat].set(v[0].astype(vc.dtype))
+        k_ctx = jnp.take(kc, pos_map, axis=0)[None]           # [1, T, H, Dh]
+        v_ctx = jnp.take(vc, pos_map, axis=0)[None]
+        attn = _attn_with_cache(q, k_ctx, v_ctx, start, scale)
+        proj = jnp.einsum("blhk,hkd->bld", attn,
+                          bp["wo"].astype(cd)) + bp["bo"].astype(cd)
+        xx = xx + proj
+
+        from ray_tpu.models.transformer import _ffn
+
+        h = _layer_norm(xx, bp["ln2_scale"], bp["ln2_bias"], cfg.eps)
+        down = _ffn(h, bp, cfg, lambda y, *a: y)
+        return xx + down, kc, vc
+
+    def scan_body(carry, inputs):
+        bp, (kc, vc) = inputs
+        out, nk, nv = one_block(carry, bp, kc, vc)
+        return out, (nk, nv)
+
+    x, (new_k, new_v) = lax.scan(
+        scan_body, x, (params["blocks"], (pool["k"], pool["v"])))
+
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.eps)
+    last = jnp.take(x[0], chunk_len - 1, axis=0)              # [D]
+    logits = jnp.einsum("d,vd->v", last.astype(jnp.float32),
+                        params["tok_embed"].astype(jnp.float32))
+    nxt = _sample_one(logits, seed, start + chunk_len, temperature,
+                      top_k)
+    return nxt[None], {"k": new_k, "v": new_v}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=(
+    "block_size",))
+def adopt_slot_paged(pool: Dict[str, Any], block_table: jax.Array,
+                     kv: Dict[str, Any], true_len: jax.Array, *,
+                     block_size: int) -> Dict[str, Any]:
+    """Scatter a contiguous bucket-sized prefill KV block (the
+    disaggregated handoff format, ``{"k","v": [L, 1, bucket, H, Dh]}``)
+    into a slot's pages. Pad rows past ``true_len`` go to scratch."""
+    bucket = kv["k"].shape[2]
+    logical = jnp.arange(bucket)
+    flat = _chunk_flat_positions(block_table, logical,
+                                 logical < true_len, block_size)
+    k = pool["k"].at[:, flat].set(kv["k"][:, 0].astype(pool["k"].dtype))
+    v = pool["v"].at[:, flat].set(kv["v"][:, 0].astype(pool["v"].dtype))
+    return {"k": k, "v": v}
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "temperature", "top_k"))
+def prefill_slots(params: Params, prompts: jax.Array,
+                  true_lens: jax.Array, seeds: jax.Array, *,
+                  cfg: GPTConfig, temperature: float = 0.0,
+                  top_k: int = 0) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Batched ``prefill_slot``: N prompts padded to one static bucket
+    run as ONE set of big matmuls (prompts [N, bucket]). Returns (first
+    sampled token per prompt [N], KV blocks {"k","v":
+    [L, N, bucket, H, Dh]}) — row ``i`` sliced out is exactly the
+    single-prompt handoff block. Compiles once per (bucket, N)."""
+    b, s = prompts.shape
+    cache = init_cache(cfg, b, s)
+    logits, cache = _forward_cached(params, prompts, cache, cfg)
+    last = jnp.take_along_axis(
+        logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]  # [N, V]
+    first = jax.vmap(
+        lambda lg, sd, ctr: _sample_one(lg, sd, ctr, temperature, top_k)
+    )(last, seeds, true_lens)
+    return first, {"k": cache["k"], "v": cache["v"]}
+
+
 @functools.partial(jax.jit, static_argnames=(
     "cfg", "max_new_tokens", "max_len", "temperature", "top_k"))
 def generate(params: Params, prompt: jax.Array, rng: jax.Array, *,

@@ -81,6 +81,7 @@ def start(http_port: Optional[int] = _DEFAULT_HTTP_PORT,
           detached: bool = True) -> None:
     """Start the Serve control plane: named controller actor (+ HTTP proxy)."""
     import ray_tpu
+    from ray_tpu._private.config import config
 
     try:
         ray_tpu.get_actor(CONTROLLER_NAME)
@@ -90,11 +91,16 @@ def start(http_port: Optional[int] = _DEFAULT_HTTP_PORT,
     ctrl_cls = ray_tpu.remote(ServeController)
     # Threaded actor: parked listen_for_change long-polls (one per live
     # handle/proxy) must not serialize control calls.
+    # The driver's non-default config (init's _system_config + any
+    # programmatic set()) rides along and is re-applied in the
+    # controller AND each proxy actor's process — worker processes do
+    # not inherit the driver's registry, and the ingress admission
+    # knobs (serve_ingress_*) are read proxy-side.
     ctrl = ctrl_cls.options(
         name=CONTROLLER_NAME,
         max_concurrency=64,
         lifetime="detached" if detached else None).remote(
-        http_port=http_port)
+        http_port=http_port, system_config=config.diff_nondefault())
     import time
     deadline = time.time() + 30
     while time.time() < deadline:

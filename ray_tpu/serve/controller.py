@@ -56,7 +56,13 @@ class _DeploymentState:
 
 
 class ServeController:
-    def __init__(self, http_port: Optional[int] = None):
+    def __init__(self, http_port: Optional[int] = None,
+                 system_config: Optional[dict] = None):
+        if system_config:
+            from ray_tpu._private.config import config
+
+            config.apply_system_config(system_config)
+        self._system_config = dict(system_config or {})
         self._deployments: Dict[str, _DeploymentState] = {}
         self._miss_counts: Dict[int, int] = {}
         self._dead_counts: Dict[int, int] = {}
@@ -423,7 +429,7 @@ class ServeController:
 
     def _reconcile_proxies(self):
         import ray_tpu
-        from ray_tpu.serve.proxy import HTTPProxy
+        from ray_tpu.serve.ingress import HTTPProxy
         from ray_tpu.util.scheduling_strategies import (
             NodeAffinitySchedulingStrategy,
         )
@@ -455,7 +461,8 @@ class ServeController:
                 # ephemeral port discovered via bound_port().
                 actor = cls.options(
                     scheduling_strategy=NodeAffinitySchedulingStrategy(
-                        node_id=nid, soft=True)).remote(self._http_port)
+                        node_id=nid, soft=True)).remote(
+                    self._http_port, system_config=self._system_config)
                 port = ray_tpu.get(actor.bound_port.remote(), timeout=10)
             except Exception:
                 # Don't leak the half-started actor or hammer an

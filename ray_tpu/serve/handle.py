@@ -109,7 +109,14 @@ class DeploymentResponseGenerator:
                 self._replica.stream_next.remote(self._sid),
                 timeout=self._timeout)
         except BaseException:
-            self._finish()
+            # Tell the replica before marking ourselves exhausted: a
+            # CLIENT-side failure (per-item timeout, interrupt) is one
+            # the replica cannot see — without the cancel its stream
+            # entry, ongoing count, and the engine request behind it
+            # would live on for a consumer that is gone. (If the error
+            # CAME from the replica it already dropped the stream and
+            # the cancel is a cheap no-op.)
+            self.cancel()
             raise
         if out.get("done"):
             self._finish()
@@ -125,6 +132,13 @@ class DeploymentResponseGenerator:
         except Exception:
             pass
         self._finish()
+
+    # ``close`` so nested streams propagate cancellation: a replica
+    # whose own streaming method wraps ANOTHER deployment's remote_gen
+    # (e.g. router -> engine pool) gets stream_cancel'd, which close()s
+    # its iterator — cancelling the inner stream instead of leaving the
+    # engine decoding for a consumer that is gone.
+    close = cancel
 
     def _finish(self):
         self._exhausted = True
@@ -341,11 +355,18 @@ class DeploymentHandle:
                                           fresh=True)[0],
             on_done=done)
 
-    def remote_gen(self, *args, **kwargs) -> DeploymentResponseGenerator:
-        return self._submit_stream(self._method, args, kwargs)
+    def remote_gen(self, *args, _item_timeout_s: Optional[float] = None,
+                   **kwargs) -> DeploymentResponseGenerator:
+        """Streaming call. ``_item_timeout_s`` (underscored so it can
+        never collide with user kwargs) bounds EACH item pull — the
+        ingress tier sets it so a wedged replica generator terminates
+        the stream instead of parking a proxy thread forever."""
+        return self._submit_stream(self._method, args, kwargs,
+                                   item_timeout_s=_item_timeout_s)
 
-    def _submit_stream(self, method: str, args,
-                       kwargs) -> DeploymentResponseGenerator:
+    def _submit_stream(self, method: str, args, kwargs,
+                       item_timeout_s: Optional[float] = None
+                       ) -> DeploymentResponseGenerator:
         import ray_tpu
         from ray_tpu.util import tracing
 
@@ -363,7 +384,9 @@ class DeploymentHandle:
         except BaseException:
             done()
             raise
-        return DeploymentResponseGenerator(replica, sid, on_done=done)
+        return DeploymentResponseGenerator(replica, sid,
+                                           timeout_s=item_timeout_s,
+                                           on_done=done)
 
 
 class _MethodCaller:
@@ -379,5 +402,7 @@ class _MethodCaller:
                 self._method, args, kwargs, fresh=True)[0],
             on_done=done)
 
-    def remote_gen(self, *args, **kwargs) -> DeploymentResponseGenerator:
-        return self._handle._submit_stream(self._method, args, kwargs)
+    def remote_gen(self, *args, _item_timeout_s: Optional[float] = None,
+                   **kwargs) -> DeploymentResponseGenerator:
+        return self._handle._submit_stream(
+            self._method, args, kwargs, item_timeout_s=_item_timeout_s)

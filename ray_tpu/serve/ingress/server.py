@@ -1,0 +1,613 @@
+"""Async HTTP/SSE ingress proxy actor (reference:
+``serve/_private/http_proxy.py:234`` HTTPProxy / :415 HTTPProxyActor —
+uvicorn there, aiohttp here).
+
+Routes ``<route_prefix>/...`` to the deployment registered with that
+prefix, and ``POST /v1/completions`` (OpenAI-style) onto an LLM
+deployment's generate/stream path. The data path is fully async:
+
+- non-streaming calls run on a DEDICATED bounded thread pool
+  (``serve_ingress_executor_threads``) with a per-call deadline — the
+  old proxy parked every request on the asyncio default executor and
+  blocked it on ``resp.result(timeout=60)``, so a burst of slow
+  requests exhausted the shared pool;
+- ``"stream": true`` completions flow token chunks to the client over
+  Server-Sent Events as the engine produces them (``data: {json}``
+  frames, ``data: [DONE]`` terminator); a client disconnect cancels
+  the replica stream, which cancels the engine request and frees its
+  slot and KV blocks;
+- every request passes admission control first (concurrency budget,
+  per-tenant fairness, watermark shedding) — see
+  ``ingress/admission.py``. Sheds answer ``429``/``503`` with a
+  ``Retry-After`` header; handle-queue-full and deadline errors map to
+  ``429``/``503``, never a blanket 500.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import threading
+from typing import Any, Dict, Optional
+
+_TENANT_DEFAULT = "default"
+_SSE_HEADERS = {
+    "Content-Type": "text/event-stream; charset=utf-8",
+    "Cache-Control": "no-cache",
+    "X-Accel-Buffering": "no",
+}
+
+
+def _ingress_metrics():
+    """Process-wide ingress metric instruments (one proxy per process
+    in practice; tags keep multi-proxy tests distinct)."""
+    from ray_tpu.util import metrics as m
+
+    if not hasattr(_ingress_metrics, "_cache"):
+        _ingress_metrics._cache = {
+            "inflight": m.Gauge(
+                "serve_ingress_inflight",
+                "Requests admitted past the ingress front door and not "
+                "yet answered (streams count until their last frame).",
+                tag_keys=("proxy",)),
+            "shed": m.Counter(
+                "serve_ingress_shed_total",
+                "Requests shed by ingress admission control, by reason "
+                "(queue_watermark, queue_timeout, tenant_rate, "
+                "downstream_overload).",
+                tag_keys=("proxy", "reason")),
+            "requests": m.Counter(
+                "serve_ingress_requests_total",
+                "Requests accepted by the ingress, per tenant.",
+                tag_keys=("proxy", "tenant")),
+            "latency": m.Histogram(
+                "serve_ingress_latency_seconds",
+                "End-to-end ingress latency (admission to last byte), "
+                "per tenant.",
+                tag_keys=("proxy", "tenant")),
+        }
+    return _ingress_metrics._cache
+
+
+class HTTPProxy:
+    def __init__(self, port: int,
+                 system_config: Optional[Dict[str, Any]] = None):
+        from ray_tpu._private.config import config
+
+        if system_config:
+            # The driver's non-default knobs (shipped via the
+            # controller): a worker process does not inherit the
+            # driver's config registry, and everything under
+            # serve_ingress_* is read HERE.
+            config.apply_system_config(system_config)
+        self.port = port           # requested; 0 = ephemeral
+        self._bound_port: Optional[int] = None
+        self._ready = threading.Event()
+        # Route table + handles are cached so the data path does not hit
+        # the controller per request. Primary freshness source is the
+        # PUSH listener below (reference: proxies learn routes via
+        # LongPollClient pushes, http_proxy.py:137); the TTL poll is
+        # bootstrap + fallback.
+        self._routes = {}          # name -> route_prefix
+        self._routes_at = 0.0
+        self._handles = {}         # name -> DeploymentHandle
+        self._route_lock = threading.Lock()
+        # The DEDICATED data-plane pool: blocking handle calls and SSE
+        # pump loops run here, never on the asyncio default executor.
+        # A stream holds one pump thread for its whole life, so the
+        # pool must cover the admission budget — otherwise admitted
+        # streams would queue invisibly (and unshed) behind the
+        # executor, the exact backlog admission exists to prevent.
+        # Threads are created on demand; an idle proxy pays nothing.
+        # max_inflight covers the long-lived pump threads; the
+        # executor_threads knob rides on TOP as headroom for the
+        # short-lived calls (route resolution, stream opens,
+        # non-streaming requests) so they never queue behind a full
+        # house of admitted streams.
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=(config.serve_ingress_max_inflight +
+                         config.serve_ingress_executor_threads),
+            thread_name_prefix="serve-ingress")
+        self._admission = None     # built on the server loop
+        # Tag by a per-instance id, not the REQUESTED port: every
+        # per-node proxy is spawned with the same port (and may fall
+        # back to an ephemeral one), so port-only tags would collide
+        # across proxies in the dashboard aggregation.
+        import uuid as _uuid
+
+        self._tags = {"proxy": f"port{port}-{_uuid.uuid4().hex[:6]}"}
+        self._m = _ingress_metrics()
+        self._thread = threading.Thread(target=self._serve_thread,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+        threading.Thread(target=self._routes_listener, daemon=True,
+                         name="serve-routes-longpoll").start()
+        # Ingress gauges/counters reach the dashboard /metrics through
+        # the process metrics reporter (idempotent per process).
+        try:
+            from ray_tpu.util import metrics as _metrics
+
+            _metrics.start_reporter(period_s=2.0)
+        except Exception:
+            pass
+
+    _ROUTES_TTL_S = 1.0
+    _LISTEN_MAX_FAILURES = 8
+
+    # ------------------------------------------------------------- routes
+
+    def _routes_listener(self):
+        """Long-poll the controller's route-table channel: every proxy
+        learns of deploys/deletes within one notify (reference:
+        http_state.py pushes route tables to all node proxies)."""
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        version = 0
+        failures = 0
+        while True:
+            try:
+                ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+                updates = ray_tpu.get(
+                    ctrl.listen_for_change.remote({"routes": version},
+                                                  25.0), timeout=35)
+            except Exception:
+                failures += 1
+                if failures >= self._LISTEN_MAX_FAILURES:
+                    return   # controller gone (serve.shutdown)
+                import time as _time
+
+                _time.sleep(1.0)
+                continue
+            failures = 0
+            if "routes" in updates:
+                version, routes = updates["routes"]
+                self._install_routes(routes)
+
+    def _install_routes(self, routes):
+        import time as _time
+
+        with self._route_lock:
+            self._routes = dict(routes)
+            self._routes_at = _time.time()
+            dropped = [h for n, h in self._handles.items()
+                       if n not in routes]
+            self._handles = {n: h for n, h in self._handles.items()
+                             if n in routes}
+        for h in dropped:
+            # Stop the dropped handle's push listener — the controller
+            # is alive, so the bounded-failure exit would never fire and
+            # the thread (plus one 25 s long-poll stream) would leak per
+            # deleted deployment.
+            try:
+                h.stop()
+            except Exception:
+                pass
+
+    def _route_table(self):
+        import time as _time
+
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        now = _time.time()
+        with self._route_lock:
+            if self._routes and now - self._routes_at < self._ROUTES_TTL_S:
+                return dict(self._routes)
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        deployments = ray_tpu.get(ctrl.list_deployments.remote(),
+                                  timeout=30)
+        routes = {name: info["config"].get("route_prefix")
+                  for name, info in deployments.items()}
+        self._install_routes(routes)
+        return dict(routes)
+
+    def _handle_for(self, name: str):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        with self._route_lock:
+            h = self._handles.get(name)
+            if h is None:
+                h = self._handles[name] = DeploymentHandle(name)
+        return h
+
+    def _resolve_route(self, path: str) -> Optional[str]:
+        """Longest-prefix route match -> deployment name."""
+        routes = self._route_table()
+        target: Optional[str] = None
+        best_len = -1
+        for name, prefix in routes.items():
+            if prefix and (path == prefix or
+                           path.startswith(prefix.rstrip("/") + "/")) \
+                    and len(prefix) > best_len:
+                target, best_len = name, len(prefix)
+        return target
+
+    # ------------------------------------------------------------ lifecycle
+
+    def ready(self) -> bool:
+        if not self._ready.wait(timeout=20):
+            raise RuntimeError("HTTP proxy failed to start")
+        return True
+
+    def bound_port(self) -> int:
+        """The actually-bound port (differs from the requested one when
+        it was taken — e.g. per-node proxies of a single-host test
+        cluster all asking for the same port)."""
+        self.ready()
+        return self._bound_port
+
+    def ingress_stats(self) -> Dict[str, Any]:
+        adm = self._admission
+        return dict(adm.stats()) if adm is not None else {}
+
+    # --------------------------------------------------------------- server
+
+    def _serve_thread(self):
+        asyncio.run(self._serve())
+
+    async def _serve(self):
+        from aiohttp import web
+
+        from ray_tpu._private.config import config
+        from ray_tpu.serve.ingress.admission import AdmissionController
+
+        self._admission = AdmissionController(
+            max_inflight=config.serve_ingress_max_inflight,
+            queue_watermark=config.serve_ingress_queue_watermark,
+            queue_timeout_s=config.serve_ingress_queue_timeout_s,
+            tenant_rate=config.serve_ingress_tenant_rate,
+            tenant_burst=config.serve_ingress_tenant_burst,
+            metrics=self._m, tags=self._tags)
+        self._tenant_header = config.serve_ingress_tenant_header
+        self._request_timeout_s = config.serve_ingress_request_timeout_s
+        self._stream_item_timeout_s = \
+            config.serve_ingress_stream_item_timeout_s
+
+        app = web.Application()
+        app.router.add_post("/v1/completions", self._completions)
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        try:
+            site = web.TCPSite(runner, "127.0.0.1", self.port)
+            await site.start()
+        except OSError:
+            # Requested port in use: fall back to an ephemeral port
+            # (callers discover it via bound_port()).
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+        self._bound_port = site._server.sockets[0].getsockname()[1]
+        self._ready.set()
+        while True:
+            await asyncio.sleep(3600)
+
+    # ------------------------------------------------------------ data path
+
+    def _tenant_of(self, request) -> str:
+        return request.headers.get(self._tenant_header) or _TENANT_DEFAULT
+
+    @staticmethod
+    def _overload_response(err) -> "web.Response":
+        from aiohttp import web
+
+        status = getattr(err, "http_status", 429)
+        retry = getattr(err, "retry_after_s", 1.0)
+        return web.json_response(
+            {"error": {"type": "overloaded", "message": str(err),
+                       "retry_after_s": round(retry, 3)}},
+            status=status,
+            headers={"Retry-After": str(max(1, int(round(retry))))})
+
+    async def _admit(self, request):
+        """Run admission; returns (tenant, None) or (tenant, response)."""
+        from ray_tpu.exceptions import ServeOverloadedError
+
+        tenant = self._tenant_of(request)
+        try:
+            await self._admission.acquire(tenant)
+        except ServeOverloadedError as e:
+            return tenant, self._overload_response(e)
+        self._m["requests"].inc(1, dict(self._tags, tenant=tenant))
+        return tenant, None
+
+    async def _call_bounded(self, fn, *args):
+        """Run a blocking data-plane call on the dedicated pool with
+        the ingress deadline (never the asyncio default executor)."""
+        loop = asyncio.get_running_loop()
+        return await asyncio.wait_for(
+            loop.run_in_executor(self._pool, fn, *args),
+            timeout=self._request_timeout_s + 5.0)
+
+    def _classify_error(self, e: BaseException):
+        """(status, payload) for a data-path failure — typed, not a
+        blanket 500."""
+        from ray_tpu.exceptions import (
+            GetTimeoutError, ServeOverloadedError,
+        )
+
+        if isinstance(e, ServeOverloadedError):
+            return None   # caller renders 429 + Retry-After
+        if isinstance(e, (GetTimeoutError, asyncio.TimeoutError,
+                          concurrent.futures.TimeoutError,
+                          TimeoutError)):
+            return 503, {"error": {"type": "timeout", "message": str(e)}}
+        return 500, {"error": {"type": "internal", "message": str(e)}}
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        from ray_tpu.exceptions import ServeOverloadedError
+
+        path = "/" + request.match_info["tail"]
+        body = await request.read()
+        payload = {"path": path,
+                   "query": dict(request.query),
+                   "method": request.method}
+        if body:
+            try:
+                payload["json"] = json.loads(body)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                payload["body"] = body
+
+        tenant, shed = await self._admit(request)
+        if shed is not None:
+            return shed
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            def route_and_call():
+                target = self._resolve_route(path)
+                if target is None:
+                    return None, 404
+                resp = self._handle_for(target).remote(payload)
+                return resp.result(
+                    timeout=self._request_timeout_s), 200
+
+            try:
+                result, code = await self._call_bounded(route_and_call)
+            except ServeOverloadedError as e:
+                # Downstream backpressure (engine queue full): surface
+                # as 429 so clients back off instead of retry-storming.
+                self._m["shed"].inc(1, dict(
+                    self._tags, reason="downstream_overload"))
+                return self._overload_response(e)
+            except Exception as e:  # noqa: BLE001
+                status, payload_out = self._classify_error(e)
+                return web.json_response(payload_out, status=status)
+            if code == 404:
+                return web.json_response(
+                    {"error": f"no deployment routes {path}"}, status=404)
+            try:
+                return web.json_response(result)
+            except TypeError:
+                return web.Response(body=str(result).encode())
+        finally:
+            self._admission.release()
+            self._m["latency"].observe(_time.monotonic() - t0,
+                                       dict(self._tags, tenant=tenant))
+
+    # ------------------------------------------------------ /v1/completions
+
+    def _completions_target(self, body: Dict[str, Any]) -> Optional[str]:
+        """The deployment serving this completion: the OpenAI-style
+        ``model`` field when it names a deployment, else the
+        conventional ``llm`` app."""
+        routes = self._route_table()
+        model = body.get("model")
+        if model and model in routes:
+            return model
+        if "llm" in routes:
+            return "llm"
+        return None
+
+    async def _completions(self, request):
+        from aiohttp import web
+
+        from ray_tpu.exceptions import ServeOverloadedError
+
+        try:
+            body = json.loads(await request.read())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return web.json_response(
+                {"error": {"type": "bad_request",
+                           "message": "body must be JSON"}}, status=400)
+        if not isinstance(body, dict) or "prompt" not in body:
+            return web.json_response(
+                {"error": {"type": "bad_request",
+                           "message": "missing 'prompt' (token id "
+                                      "list)"}}, status=400)
+        req = {"prompt": body["prompt"],
+               "n": body.get("max_tokens"),
+               "seed": body.get("seed") or 0}
+        stream = bool(body.get("stream"))
+
+        tenant, shed = await self._admit(request)
+        if shed is not None:
+            return shed
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            # Route resolution may RPC the controller on a cold cache —
+            # keep it off the event loop, and map its failures like any
+            # other data-path deadline (503, not a blanket 500).
+            try:
+                target = await self._call_bounded(
+                    self._completions_target, body)
+            except ServeOverloadedError as e:
+                return self._overload_response(e)
+            except Exception as e:  # noqa: BLE001
+                status, payload_out = self._classify_error(e)
+                return web.json_response(payload_out, status=status)
+            if target is None:
+                return web.json_response(
+                    {"error": {"type": "not_found",
+                               "message": "no LLM deployment (set "
+                                          "'model' or deploy 'llm')"}},
+                    status=404)
+            handle = self._handle_for(target)
+            if not stream:
+                def call():
+                    return handle.remote(req).result(
+                        timeout=self._request_timeout_s)
+
+                try:
+                    out = await self._call_bounded(call)
+                except ServeOverloadedError as e:
+                    self._m["shed"].inc(1, dict(
+                        self._tags, reason="downstream_overload"))
+                    return self._overload_response(e)
+                except Exception as e:  # noqa: BLE001
+                    status, payload_out = self._classify_error(e)
+                    return web.json_response(payload_out, status=status)
+                return web.json_response(self._completion_body(
+                    target, out.get("tokens") or []))
+
+            # Open the replica stream BEFORE committing a 200: the
+            # engine's queue-full/validation errors surface at stream
+            # START, and a shed must be a real 429/Retry-After the
+            # client can act on — not an error frame inside a
+            # success-status SSE body.
+            def start_stream():
+                return handle.generate_stream.remote_gen(
+                    req, _item_timeout_s=self._stream_item_timeout_s)
+
+            loop = asyncio.get_running_loop()
+            inner = loop.run_in_executor(self._pool, start_stream)
+
+            def _reap_abandoned(f):
+                # The handler went away (disconnect/deadline) while the
+                # stream was still opening: cancel it the moment it
+                # exists so the engine doesn't decode a full budget for
+                # nobody.
+                if not f.cancelled() and f.exception() is None:
+                    try:
+                        # raylint: disable-next=unbounded-wait (done
+                        # callback: f has already completed, result()
+                        # cannot block)
+                        f.result().cancel()
+                    except Exception:
+                        pass
+
+            try:
+                gen = await asyncio.wait_for(
+                    asyncio.shield(inner),
+                    timeout=self._request_timeout_s + 5.0)
+            except ServeOverloadedError as e:
+                self._m["shed"].inc(1, dict(
+                    self._tags, reason="downstream_overload"))
+                return self._overload_response(e)
+            except asyncio.CancelledError:
+                inner.add_done_callback(_reap_abandoned)
+                raise
+            except asyncio.TimeoutError as e:
+                inner.add_done_callback(_reap_abandoned)
+                status, payload_out = self._classify_error(e)
+                return web.json_response(payload_out, status=status)
+            except Exception as e:  # noqa: BLE001
+                status, payload_out = self._classify_error(e)
+                return web.json_response(payload_out, status=status)
+            return await self._stream_completions(request, gen, target)
+        finally:
+            self._admission.release()
+            self._m["latency"].observe(_time.monotonic() - t0,
+                                       dict(self._tags, tenant=tenant))
+
+    @staticmethod
+    def _completion_body(model: str, tokens, finished: bool = True):
+        return {"object": "text_completion", "model": model,
+                "choices": [{"index": 0, "tokens": list(tokens),
+                             "finish_reason": "stop" if finished
+                             else None}],
+                "usage": {"completion_tokens": len(tokens)}}
+
+    async def _stream_completions(self, request, gen, target):
+        """SSE: one ``data: {"tokens": [...]}`` frame per engine chunk,
+        ``data: [DONE]`` terminator. ``gen`` is the already-opened
+        replica stream (opening it raises queue-full BEFORE the 200 is
+        committed). The blocking pump runs on the dedicated pool and
+        feeds the response through a queue; if the client goes away the
+        pump is stopped and the replica stream CANCELLED — the engine
+        request's slot and KV blocks free instead of decoding to budget
+        for a dead socket."""
+        from aiohttp import web
+
+        loop = asyncio.get_running_loop()
+        out_q: asyncio.Queue = asyncio.Queue()
+        stop = threading.Event()
+        gen_box: Dict[str, Any] = {"gen": gen}
+
+        def pump():
+            try:
+                for chunk in gen:
+                    if stop.is_set():
+                        gen.cancel()
+                        return
+                    loop.call_soon_threadsafe(
+                        out_q.put_nowait, ("chunk", chunk))
+                loop.call_soon_threadsafe(out_q.put_nowait, ("done", None))
+            except BaseException as e:  # noqa: BLE001
+                try:
+                    loop.call_soon_threadsafe(
+                        out_q.put_nowait, ("error", e))
+                except RuntimeError:
+                    pass   # loop closed during shutdown
+
+        resp = web.StreamResponse(headers=dict(_SSE_HEADERS))
+        pump_fut = self._pool.submit(pump)
+        try:
+            await resp.prepare(request)
+            while True:
+                kind, item = await asyncio.wait_for(
+                    out_q.get(),
+                    timeout=self._stream_item_timeout_s + 10.0)
+                if kind == "chunk":
+                    frame = json.dumps(
+                        {"model": target,
+                         "choices": [{"index": 0,
+                                      "tokens": list(item)}]})
+                    await resp.write(f"data: {frame}\n\n".encode())
+                elif kind == "done":
+                    await resp.write(b"data: [DONE]\n\n")
+                    break
+                else:   # error from the replica stream
+                    # Belt and braces: the generator cancels itself on
+                    # its own errors, but make sure the replica side is
+                    # told before we abandon the stream.
+                    self._cancel_stream(stop, gen_box)
+                    err_frame = json.dumps(
+                        {"error": {"type": "stream_error",
+                                   "message": str(item)}})
+                    await resp.write(f"data: {err_frame}\n\n".encode())
+                    break
+            await resp.write_eof()
+        except asyncio.CancelledError:
+            # Client disconnected (aiohttp cancels the handler): stop
+            # the pump and cancel the replica-side stream so the engine
+            # frees the request's slot/KV blocks.
+            self._cancel_stream(stop, gen_box)
+            raise
+        except (ConnectionResetError, ConnectionError,
+                asyncio.TimeoutError):
+            # Write raced the disconnect (or the stream wedged): same
+            # cleanup, but swallow — a gone client is not a server
+            # error worth a traceback per disconnect.
+            self._cancel_stream(stop, gen_box)
+        finally:
+            stop.set()
+            pump_fut.cancel()
+        return resp
+
+    @staticmethod
+    def _cancel_stream(stop: threading.Event,
+                       gen_box: Dict[str, Any]) -> None:
+        stop.set()
+        gen = gen_box.get("gen")
+        if gen is not None:
+            try:
+                gen.cancel()
+            except Exception:
+                pass

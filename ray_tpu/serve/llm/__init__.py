@@ -12,6 +12,7 @@ from ray_tpu.serve.llm.engine import (  # noqa: F401
     InflightBatchEngine,
 )
 from ray_tpu.serve.llm.kv_transfer import adopt_kv, publish_kv  # noqa: F401
+from ray_tpu.serve.llm.paged import BlockPool  # noqa: F401
 from ray_tpu.serve.llm.replicas import (  # noqa: F401
     DecodeReplica,
     LLMReplica,
@@ -22,5 +23,5 @@ from ray_tpu.serve.llm.router import LLMRouter, build_llm_app  # noqa: F401
 __all__ = [
     "EngineConfig", "InflightBatchEngine", "LLMReplica", "PrefillReplica",
     "DecodeReplica", "LLMRouter", "build_llm_app", "publish_kv",
-    "adopt_kv",
+    "adopt_kv", "BlockPool",
 ]

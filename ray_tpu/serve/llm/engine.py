@@ -62,6 +62,18 @@ class EngineConfig:
     top_k: int = 0
     param_seed: int = 0
     max_queue: int = 4096             # admission backpressure
+    # --- paged KV (block-granular cache; see models/generate.py) ------
+    paged_kv: bool = False            # block pool instead of per-slot
+    #                                   max_len reservations
+    kv_block_size: int = 16           # tokens per KV block
+    kv_num_blocks: int = 0            # 0 = parity with the reserved
+    #                                   layout: slots*ceil(max_len/bs)+1
+    prefill_chunk: int = 32           # chunked-prefill chunk length
+    max_kv_bytes: int = 0             # 0 = unlimited; else engine init
+    #                                   refuses a KV allocation above it
+    # --- prefill micro-batching (PrefillReplica) ----------------------
+    prefill_batch_size: int = 1       # 1 = one prompt per program call
+    prefill_batch_window_ms: float = 2.0
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "EngineConfig":
@@ -93,6 +105,20 @@ class EngineConfig:
 
             overrides["dtype"] = getattr(jnp, overrides["dtype"])
         return GPTConfig.preset(self.preset, **overrides)
+
+    def kv_bytes_per_token(self, cfg=None) -> int:
+        """Bytes of K+V cache one token of one sequence occupies."""
+        import numpy as np
+
+        cfg = cfg or self.gpt_config()
+        return int(2 * cfg.n_layers * cfg.n_heads * cfg.head_dim *
+                   np.dtype(cfg.dtype).itemsize)
+
+    def kv_pool_blocks(self) -> int:
+        """Paged pool size in blocks (scratch block 0 included):
+        explicit ``kv_num_blocks`` or reserved-layout parity."""
+        per_slot = -(-self.max_len // self.kv_block_size)
+        return self.kv_num_blocks or (self.max_slots * per_slot + 1)
 
 
 # ------------------------------------------------------------------ metrics
@@ -127,6 +153,15 @@ def engine_metrics() -> Dict[str, Any]:
                     "serve_llm_tokens_total",
                     "Tokens produced by the in-flight batching engine.",
                     tag_keys=tags),
+                "kv_occupancy": Gauge(
+                    "serve_llm_kv_block_occupancy",
+                    "Fraction of the paged KV block pool in use.",
+                    tag_keys=tags),
+                "preempts": Counter(
+                    "serve_llm_kv_preempts_total",
+                    "Sequences preempted (recompute-resumed) because "
+                    "the KV block pool could not grow them.",
+                    tag_keys=tags),
             }
         return _metrics
 
@@ -134,7 +169,8 @@ def engine_metrics() -> Dict[str, Any]:
 class _Request:
     __slots__ = ("id", "kind", "prompt", "budget", "seed", "kv",
                  "first_token", "true_len", "tokens", "cursor", "done",
-                 "error", "t_submit", "t_first", "truncated")
+                 "error", "t_submit", "t_first", "truncated",
+                 "cancelled", "produced", "resume_tokens")
 
     def __init__(self, kind: str, *, prompt=None, budget: int = 0,
                  seed: int = 0, kv=None, first_token: Optional[int] = None,
@@ -154,6 +190,22 @@ class _Request:
         self.t_submit = time.monotonic()
         self.t_first: Optional[float] = None
         self.truncated = False
+        self.cancelled = False            # consumer went away
+        self.produced = 0                 # generated tokens (incl. the
+        #                                   prefill-pool token for the
+        #                                   prefilled kind)
+        self.resume_tokens: Optional[List[int]] = None  # preempted: the
+        #                                   full sequence to re-prefill
+
+    def full_sequence(self) -> List[int]:
+        """prompt + every generated token — what a preempted request
+        re-prefills to resume exactly where it left off (sampling is
+        deterministic in (seed, position), so recompute-resume emits
+        the same continuation the uninterrupted run would have)."""
+        seq = list(self.prompt or [])
+        if self.kind == "prefilled" and self.first_token is not None:
+            seq.append(self.first_token)
+        return seq + list(self.tokens)
 
 
 class InflightBatchEngine:
@@ -166,7 +218,9 @@ class InflightBatchEngine:
         import jax.numpy as jnp
         import numpy as np
 
-        from ray_tpu.models.generate import init_slotted_cache
+        from ray_tpu.models.generate import (
+            init_paged_pool, init_slotted_cache,
+        )
 
         self._params = params
         self._cfg = cfg
@@ -179,7 +233,32 @@ class InflightBatchEngine:
                 f"{cfg.max_seq}")
 
         B = engine_cfg.max_slots
-        self._cache = init_slotted_cache(cfg, B, engine_cfg.max_len)
+        per_tok = engine_cfg.kv_bytes_per_token(cfg)
+        if engine_cfg.paged_kv:
+            from ray_tpu.serve.llm.paged import BlockPool
+
+            bs = engine_cfg.kv_block_size
+            self._slot_blocks_max = -(-engine_cfg.max_len // bs)
+            nb = engine_cfg.kv_pool_blocks()
+            self._check_kv_budget(nb * bs * per_tok, "paged KV pool")
+            self._pool = BlockPool(nb, bs)
+            self._cache = init_paged_pool(cfg, nb, bs, B,
+                                          self._slot_blocks_max)
+            # Host mirrors of the device block tables / lengths; pushed
+            # to the device cache when dirty (scheduler thread only).
+            self._bt = np.zeros((B, self._slot_blocks_max), np.int32)
+            self._lengths = np.zeros((B,), np.int32)
+            self._blocks: List[List[int]] = [[] for _ in range(B)]
+            self._bt_dirty = False
+            # Chunked-prefill queue: dicts {"slot","req","tokens","done"}
+            # processed one chunk per scheduler pass, interleaved with
+            # decode steps (long prompts never stall the decode batch).
+            self._prefill_q: List[Dict[str, Any]] = []
+        else:
+            self._pool = None
+            self._check_kv_budget(B * engine_cfg.max_len * per_tok,
+                                  "reserved (max_len-per-slot) KV cache")
+            self._cache = init_slotted_cache(cfg, B, engine_cfg.max_len)
         self._slot_req: List[Optional[_Request]] = [None] * B
         self._last_tokens = np.zeros((B,), np.int32)
         self._active = np.zeros((B,), bool)
@@ -198,6 +277,22 @@ class InflightBatchEngine:
             target=self._loop, daemon=True,
             name=f"llm-engine-{deployment}-{replica_id}")
         self._thread.start()
+
+    def _check_kv_budget(self, need_bytes: int, what: str) -> None:
+        """Refuse a KV allocation above ``max_kv_bytes`` at INIT — a
+        typed failure before the engine OOMs the device. This is the
+        boundary the open-loop bench's long-context case exercises: the
+        reserved layout needs ``slots x max_len`` rows up front and
+        trips it, the paged pool sized for actual live tokens fits."""
+        budget = self._ec.max_kv_bytes
+        if budget and need_bytes > budget:
+            from ray_tpu.exceptions import KVCacheExhaustedError
+
+            raise KVCacheExhaustedError(
+                f"{what} needs {need_bytes} bytes "
+                f"(> max_kv_bytes {budget}): "
+                f"{self._ec.max_slots} slots x max_len "
+                f"{self._ec.max_len}")
 
     # ----------------------------------------------------------- admission
 
@@ -226,14 +321,32 @@ class InflightBatchEngine:
             if self._stopped:
                 raise RuntimeError("engine is stopped")
             if len(self._pending) >= self._ec.max_queue:
-                raise RuntimeError(
-                    f"engine queue full ({self._ec.max_queue})")
+                from ray_tpu.exceptions import ServeOverloadedError
+
+                raise ServeOverloadedError(
+                    f"engine queue full ({self._ec.max_queue})",
+                    retry_after_s=1.0, reason="engine_queue_full")
             self._pending.append(req)
             self._requests[req.id] = req
-            depth = len(self._pending)
+            # Publish INSIDE the lock: gauge updates are then serialized
+            # with stop()'s zeroing, so a racing submit can never
+            # overwrite the final gauge after shutdown.
+            self._m["queue_depth"].set(len(self._pending), self._tags)
             self._cv.notify_all()
-        self._m["queue_depth"].set(depth, self._tags)
         return req.id
+
+    def _check_pool_fit(self, total_tokens: int) -> None:
+        """Paged admission sanity: a sequence whose prompt + budget can
+        NEVER fit the block pool fails typed at submit instead of
+        parking in the queue forever."""
+        if self._pool is not None and not self._pool.can_fit(
+                total_tokens):
+            from ray_tpu.exceptions import KVCacheExhaustedError
+
+            raise KVCacheExhaustedError(
+                f"sequence of {total_tokens} tokens needs "
+                f"{self._pool.blocks_for(total_tokens)} KV blocks but "
+                f"the pool only has {self._pool.capacity}")
 
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
@@ -242,24 +355,50 @@ class InflightBatchEngine:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
-        self._bucket_for(len(prompt))   # validate against buckets now
+        if self._pool is None:
+            self._bucket_for(len(prompt))  # validate against buckets now
         budget = self._check_budget(len(prompt), max_new_tokens)
+        self._check_pool_fit(len(prompt) + budget)
         return self._enqueue(_Request(
             "prompt", prompt=prompt, budget=budget, seed=int(seed)))
 
     def submit_prefilled(self, first_token: int, kv: Dict[str, Any],
                          true_len: int,
                          max_new_tokens: Optional[int] = None,
-                         seed: int = 0) -> str:
+                         seed: int = 0,
+                         prompt: Optional[Sequence[int]] = None) -> str:
         """Queue a sequence prefilled elsewhere (disaggregated decode
         pool). ``kv`` holds the bucket-sized K/V blocks ({"k","v"},
         device arrays or host arrays freshly rebuilt off the arena);
         ``first_token`` was sampled by the prefill pool and is NOT
-        re-emitted here — the engine produces tokens 2..budget."""
+        re-emitted here — the engine produces tokens 2..budget.
+        ``prompt`` (the raw token ids, optional) enables
+        recompute-resume if the paged pool preempts this sequence."""
         budget = self._check_budget(int(true_len), max_new_tokens)
+        self._check_pool_fit(int(true_len) + budget)
         return self._enqueue(_Request(
             "prefilled", kv=kv, first_token=int(first_token),
+            prompt=[int(t) for t in prompt] if prompt else None,
             true_len=int(true_len), budget=budget, seed=int(seed)))
+
+    def cancel(self, req_id: str) -> bool:
+        """Abandon a request (its consumer went away — e.g. an SSE
+        client disconnected): it is forgotten immediately; the
+        scheduler thread retires its slot and frees its KV blocks at
+        the next pass boundary. Returns whether the id was live."""
+        with self._cv:
+            req = self._requests.pop(req_id, None)
+            if req is None:
+                return False
+            req.cancelled = True
+            try:
+                self._pending.remove(req)
+                self._m["queue_depth"].set(len(self._pending),
+                                           self._tags)
+            except ValueError:
+                pass               # already holds a slot (or prefilling)
+            self._cv.notify_all()
+        return True
 
     # ----------------------------------------------------------- consumers
 
@@ -321,13 +460,19 @@ class InflightBatchEngine:
                max_wait_s: float = 1.0) -> Iterator[List[int]]:
         """Generator of token CHUNKS for one request: each item is
         whatever accumulated since the last pull (>= 1 token, except
-        possibly the final empty completion)."""
-        while True:
-            out = self.drain(req_id, max_wait_s=max_wait_s)
-            if out["tokens"]:
-                yield out["tokens"]
-            if out["done"]:
-                return
+        possibly the final empty completion). An abandoned stream
+        (``close()`` / consumer error) CANCELS the request — the slot
+        and its KV blocks free instead of decoding out the budget."""
+        try:
+            while True:
+                out = self.drain(req_id, max_wait_s=max_wait_s)
+                if out["tokens"]:
+                    yield out["tokens"]
+                if out["done"]:
+                    return
+        finally:
+            # No-op when the request already drained to done/error.
+            self.cancel(req_id)
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: Optional[int] = None,
@@ -342,14 +487,22 @@ class InflightBatchEngine:
         with self._cv:
             queue = len(self._pending)
             busy = int(self._active.sum())
-        return {
+            prefilling = len(self._prefill_q) if self._pool is not None \
+                else 0
+            pool_stats = dict(self._pool.stats()) \
+                if self._pool is not None else {}
+        out = {
             "queue_depth": queue,
             "busy_slots": busy,
+            "prefilling": prefilling,
             "max_slots": self._ec.max_slots,
             "batch_occupancy": busy / self._ec.max_slots,
-            "autoscale_load": queue + busy,
+            "autoscale_load": queue + busy + prefilling,
             "steps": self._steps,
+            "paged_kv": self._pool is not None,
         }
+        out.update(pool_stats)
+        return out
 
     def stop(self) -> None:
         with self._cv:
@@ -359,6 +512,17 @@ class InflightBatchEngine:
                     req.error = RuntimeError("engine stopped")
             self._cv.notify_all()
         self._thread.join(timeout=_STOP_JOIN_S)
+        # Zero the gauges AFTER the scheduler thread exits (an
+        # in-flight pass republishes occupancy as it retires slots) and
+        # under the same lock every publisher holds: a racing submit
+        # either published before stop() took the lock (overwritten
+        # here) or sees _stopped and raises — the final exported state
+        # is deterministically zero.
+        with self._cv:
+            self._m["queue_depth"].set(0, self._tags)
+            self._m["batch_occupancy"].set(0, self._tags)
+            if self._pool is not None:
+                self._m["kv_occupancy"].set(0, self._tags)
 
     # ----------------------------------------------------------- scheduler
 
@@ -366,19 +530,25 @@ class InflightBatchEngine:
         return [i for i, r in enumerate(self._slot_req) if r is None]
 
     def _loop(self) -> None:
+        paged = self._pool is not None
         while True:
             with self._cv:
                 if self._stopped:
                     return
             try:
-                admitted = self._admit()
-                stepped = self._step()
+                self._reap_cancelled()
+                if paged:
+                    progress = self._admit_paged()
+                    progress = self._prefill_tick() or progress
+                else:
+                    progress = self._admit()
+                progress = self._step() or progress
             except Exception as e:  # compile/runtime failure: fail loud,
                 self._poison(e)     # per-request, not a silent wedge
                 continue
-            if not admitted and not stepped:
+            if not progress:
                 with self._cv:
-                    if not self._pending and not self._active.any():
+                    if not self._stopped:
                         self._cv.wait(_IDLE_WAIT_S)
 
     def _poison(self, err: BaseException) -> None:
@@ -389,10 +559,32 @@ class InflightBatchEngine:
                 if not req.done and req.error is None:
                     req.error = err
             self._pending.clear()
+            self._m["queue_depth"].set(0, self._tags)
             for i in range(len(self._slot_req)):
                 self._slot_req[i] = None
+                if self._pool is not None:
+                    self._free_slot_blocks(i)
+            if self._pool is not None:
+                self._prefill_q.clear()
             self._active[:] = False
+            self._publish_occupancy_locked()
             self._cv.notify_all()
+
+    def _reap_cancelled(self) -> None:
+        """Retire slots whose request was cancelled (consumer gone):
+        the slot and its KV blocks return to the pool without waiting
+        for the budget to run out."""
+        with self._cv:
+            for slot, req in enumerate(self._slot_req):
+                if req is None or not req.cancelled:
+                    continue
+                self._slot_req[slot] = None
+                self._active[slot] = False
+                if self._pool is not None:
+                    self._prefill_q = [e for e in self._prefill_q
+                                       if e["slot"] != slot]
+                    self._free_slot_blocks(slot)
+            self._publish_occupancy_locked()
 
     def _admit(self) -> bool:
         """Move queued requests into free slots: prefill (or adopt) and
@@ -406,7 +598,10 @@ class InflightBatchEngine:
             free = self._free_slots()
             take: List[Tuple[int, _Request]] = []
             while free and self._pending:
-                take.append((free.pop(0), self._pending.popleft()))
+                req = self._pending.popleft()
+                if req.cancelled:
+                    continue
+                take.append((free.pop(0), req))
             if take:
                 self._m["queue_depth"].set(len(self._pending), self._tags)
         if not take:
@@ -445,6 +640,7 @@ class InflightBatchEngine:
             self._seeds[slot] = req.seed
             self._active[slot] = True
             self._produced[slot] = 1   # the prefill-sampled token
+            req.produced = 1
             self._slot_req[slot] = req
             now = time.monotonic()
             with self._cv:
@@ -457,8 +653,8 @@ class InflightBatchEngine:
             self._m["ttft"].observe(now - req.t_submit, self._tags)
             if emit_first:
                 self._m["tokens"].inc(1, self._tags)
-        self._m["batch_occupancy"].set(
-            float(self._active.sum()) / self._ec.max_slots, self._tags)
+        with self._cv:
+            self._publish_occupancy_locked()
         return True
 
     def _retire_slot_locked(self, slot: int) -> None:
@@ -467,21 +663,275 @@ class InflightBatchEngine:
             req.done = True
         self._slot_req[slot] = None
         self._active[slot] = False
+        if self._pool is not None:
+            self._free_slot_blocks(slot)
+
+    # ------------------------------------------------- paged-KV scheduling
+
+    def _free_slot_blocks(self, slot: int) -> None:
+        """Return a slot's blocks to the pool and point its table at the
+        scratch block (a stale table must never alias a reassigned
+        block). Called with ``_cv`` held or from the scheduler thread."""
+        if self._blocks[slot]:
+            self._pool.free(self._blocks[slot])
+            self._blocks[slot] = []
+        self._bt[slot] = 0
+        self._lengths[slot] = 0
+        self._bt_dirty = True
+
+    def _publish_occupancy_locked(self) -> None:
+        self._m["batch_occupancy"].set(
+            float(self._active.sum()) / self._ec.max_slots, self._tags)
+        if self._pool is not None:
+            self._m["kv_occupancy"].set(self._pool.occupancy(),
+                                        self._tags)
+
+    def _sync_device_tables(self) -> None:
+        """Push the host block-table / length mirrors to the device
+        cache when admission/retire/growth changed them (tiny int32
+        arrays; decode itself advances device lengths in lockstep with
+        the host mirror, so a clean pass needs no transfer)."""
+        if self._bt_dirty:
+            self._cache["block_tables"] = self._jnp.asarray(self._bt)
+            self._cache["lengths"] = self._jnp.asarray(self._lengths)
+            self._bt_dirty = False
+
+    def _admit_paged(self) -> bool:
+        """Admit queued requests into free slots of the paged batch.
+        Fresh prompts (and recompute-resumes) enter the chunked-prefill
+        queue; prefilled handoffs adopt their KV block into pages
+        directly. Block allocation is all-or-nothing per sequence and
+        FIFO — a request the pool cannot serve YET parks at the queue
+        head rather than being overtaken (no starvation)."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.generate import adopt_slot_paged
+
+        progress = False
+        while True:
+            with self._cv:
+                busy_prefill = {e["slot"] for e in self._prefill_q}
+                free = [s for s in self._free_slots()
+                        if s not in busy_prefill]
+                if not free or not self._pending:
+                    break
+                req = self._pending.popleft()
+                if req.cancelled:
+                    self._m["queue_depth"].set(len(self._pending),
+                                               self._tags)
+                    continue
+                slot = free[0]
+                # Reserve the slot under the lock; compute happens out.
+                self._slot_req[slot] = req
+                self._m["queue_depth"].set(len(self._pending),
+                                           self._tags)
+
+            if req.kind == "prefilled" and req.resume_tokens is None:
+                seq_len = req.true_len
+            else:
+                seq = req.resume_tokens if req.resume_tokens is not None \
+                    else req.prompt
+                seq_len = len(seq)
+            got = self._pool.alloc(self._pool.blocks_for(seq_len))
+            if got is None:
+                # Pool busy: give the slot back and repark at the HEAD.
+                with self._cv:
+                    self._slot_req[slot] = None
+                    if not req.cancelled:
+                        self._pending.appendleft(req)
+                        self._m["queue_depth"].set(len(self._pending),
+                                                   self._tags)
+                break
+            self._blocks[slot] = got
+            self._bt[slot] = 0
+            self._bt[slot][:len(got)] = got
+            self._bt_dirty = True
+
+            if req.kind == "prefilled" and req.resume_tokens is None:
+                # Disaggregated handoff: splice the contiguous prefill
+                # block into the slot's pages; the first token was
+                # sampled (and delivered) by the prefill pool.
+                try:
+                    kv = {"k": jnp.asarray(req.kv["k"]),
+                          "v": jnp.asarray(req.kv["v"])}
+                    req.kv = None
+                    self._sync_device_tables()
+                    pool_kv = {"k": self._cache["k"],
+                               "v": self._cache["v"]}
+                    pool_kv = adopt_slot_paged(
+                        pool_kv, jnp.asarray(self._bt[slot]), kv,
+                        jnp.int32(req.true_len),
+                        block_size=self._pool.block_size)
+                    self._cache["k"] = pool_kv["k"]
+                    self._cache["v"] = pool_kv["v"]
+                except Exception as e:
+                    with self._cv:
+                        req.error = e
+                        self._slot_req[slot] = None
+                        self._free_slot_blocks(slot)
+                        self._cv.notify_all()
+                    continue
+                self._activate_slot_paged(slot, req, seq_len=req.true_len,
+                                          token=req.first_token,
+                                          emit=False)
+            else:
+                with self._cv:
+                    self._prefill_q.append(
+                        {"slot": slot, "req": req, "tokens": seq,
+                         "done": 0})
+            progress = True
+        return progress
+
+    def _prefill_tick(self) -> bool:
+        """Run ONE chunk of the oldest prefilling prompt — FCFS for
+        TTFT, one chunk per scheduler pass so a long prompt interleaves
+        with decode steps instead of stalling the whole batch."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.generate import prefill_chunk_paged
+
+        with self._cv:
+            entry = self._prefill_q[0] if self._prefill_q else None
+        if entry is None:
+            return False
+        req, slot = entry["req"], entry["slot"]
+        if req.cancelled:   # reaped next pass
+            return True
+        C = max(1, self._ec.prefill_chunk)
+        toks = entry["tokens"]
+        start = entry["done"]
+        chunk = toks[start:start + C]
+        padded = self._np.zeros((1, C), self._np.int32)
+        padded[0, :len(chunk)] = chunk
+        self._sync_device_tables()
+        pool_kv = {"k": self._cache["k"], "v": self._cache["v"]}
+        first, pool_kv = prefill_chunk_paged(
+            self._params, pool_kv, jnp.asarray(self._bt[slot]),
+            jnp.asarray(padded), jnp.int32(start),
+            jnp.int32(len(chunk)), jnp.int32(req.seed), cfg=self._cfg,
+            block_size=self._pool.block_size,
+            temperature=self._ec.temperature, top_k=self._ec.top_k)
+        self._cache["k"] = pool_kv["k"]
+        self._cache["v"] = pool_kv["v"]
+        entry["done"] = start + len(chunk)
+        if entry["done"] < len(toks):
+            return True
+        with self._cv:
+            if self._prefill_q and self._prefill_q[0] is entry:
+                self._prefill_q.pop(0)
+        # Prefill complete: the sampled token is the next token of the
+        # sequence (for a resume, the continuation token — same counter
+        # the uninterrupted decode would have used).
+        self._activate_slot_paged(
+            slot, req, seq_len=len(toks), token=int(first[0]),
+            emit=not (req.kind == "prefilled" and req.produced == 0))
+        return True
+
+    def _activate_slot_paged(self, slot: int, req: _Request,
+                             seq_len: int, token: int,
+                             emit: bool) -> None:
+        """Move a slot from prefilling/adopted to decode-active."""
+        self._lengths[slot] = seq_len
+        self._bt_dirty = True
+        self._last_tokens[slot] = token
+        self._seeds[slot] = req.seed
+        self._active[slot] = True
+        req.resume_tokens = None
+        req.produced += 1
+        self._produced[slot] = req.produced
+        now = time.monotonic()
+        first_activation = req.t_first is None
+        with self._cv:
+            if first_activation:
+                req.t_first = now
+            if emit:
+                req.tokens.append(token)
+            if req.produced >= req.budget or seq_len >= self._ec.max_len:
+                if seq_len >= self._ec.max_len and \
+                        req.produced < req.budget:
+                    req.truncated = True
+                self._retire_slot_locked(slot)
+            self._publish_occupancy_locked()
+            self._cv.notify_all()
+        if first_activation:
+            self._m["ttft"].observe(now - req.t_submit, self._tags)
+        if emit:
+            self._m["tokens"].inc(1, self._tags)
+
+    def _grow_or_preempt(self) -> None:
+        """Before a decode step every active slot needs a page for its
+        next token. A slot the pool cannot grow is PREEMPTED by
+        recompute: its blocks return to the pool and the request reparks
+        at the queue head as a resume (prompt + generated-so-far), to be
+        re-prefilled when blocks free up — generation continues exactly
+        where it stopped (sampling is deterministic in position)."""
+        bs = self._pool.block_size
+        for slot, req in enumerate(self._slot_req):
+            if req is None or not self._active[slot]:
+                continue
+            need = int(self._lengths[slot]) // bs + 1
+            if len(self._blocks[slot]) >= need:
+                continue
+            got = self._pool.alloc(1)
+            if got is not None:
+                self._bt[slot][len(self._blocks[slot])] = got[0]
+                self._blocks[slot].extend(got)
+                self._bt_dirty = True
+                continue
+            self._preempt_slot(slot, req)
+
+    def _preempt_slot(self, slot: int, req: _Request) -> None:
+        self._m["preempts"].inc(1, self._tags)
+        with self._cv:
+            self._active[slot] = False
+            self._slot_req[slot] = None
+            self._free_slot_blocks(slot)
+            if req.cancelled:
+                pass
+            elif req.prompt is None:
+                from ray_tpu.exceptions import KVCacheExhaustedError
+
+                # Pre-prompt-carrying handoffs cannot be recomputed.
+                req.error = KVCacheExhaustedError(
+                    "KV pool exhausted and the handoff carried no "
+                    "prompt tokens for recompute-resume")
+            else:
+                req.resume_tokens = req.full_sequence()
+                self._pending.appendleft(req)
+                self._m["queue_depth"].set(len(self._pending),
+                                           self._tags)
+            self._publish_occupancy_locked()
+            self._cv.notify_all()
 
     def _step(self) -> bool:
         """One batched decode step; emit the new token of every active
         slot and retire exhausted sequences."""
         import jax.numpy as jnp
 
-        from ray_tpu.models.generate import decode_step
+        from ray_tpu.models.generate import decode_step, decode_step_paged
 
+        if self._pool is not None:
+            self._grow_or_preempt()
         if not self._active.any():
             return False
-        nxt, self._cache = decode_step(
-            self._params, self._cache,
-            jnp.asarray(self._last_tokens), jnp.asarray(self._active),
-            jnp.asarray(self._seeds), cfg=self._cfg,
-            temperature=self._ec.temperature, top_k=self._ec.top_k)
+        if self._pool is not None:
+            self._sync_device_tables()
+            active_now = self._active.copy()
+            nxt, self._cache = decode_step_paged(
+                self._params, self._cache,
+                jnp.asarray(self._last_tokens), jnp.asarray(active_now),
+                jnp.asarray(self._seeds), cfg=self._cfg,
+                block_size=self._pool.block_size,
+                temperature=self._ec.temperature, top_k=self._ec.top_k)
+            # Device lengths advanced for active slots; keep the host
+            # mirror in lockstep so growth/retire decisions are exact.
+            self._lengths += active_now.astype(self._np.int32)
+        else:
+            nxt, self._cache = decode_step(
+                self._params, self._cache,
+                jnp.asarray(self._last_tokens), jnp.asarray(self._active),
+                jnp.asarray(self._seeds), cfg=self._cfg,
+                temperature=self._ec.temperature, top_k=self._ec.top_k)
         nxt = self._np.asarray(nxt)       # the per-step host sync
         self._steps += 1
 
@@ -494,12 +944,17 @@ class InflightBatchEngine:
                 token = int(nxt[slot])
                 self._last_tokens[slot] = token
                 self._produced[slot] += 1
+                req.produced += 1
                 req.tokens.append(token)
                 emitted += 1
-                full = req.true_len if req.kind == "prefilled" \
-                    else len(req.prompt)
-                cache_full = full + self._produced[slot] >= \
-                    self._ec.max_len
+                if self._pool is not None:
+                    cache_full = int(self._lengths[slot]) >= \
+                        self._ec.max_len
+                else:
+                    full = req.true_len if req.kind == "prefilled" \
+                        else len(req.prompt)
+                    cache_full = full + self._produced[slot] >= \
+                        self._ec.max_len
                 if cache_full and self._produced[slot] < req.budget:
                     req.truncated = True
                 if self._produced[slot] >= req.budget or cache_full:
@@ -509,7 +964,6 @@ class InflightBatchEngine:
         if emitted:
             self._m["tokens"].inc(emitted, self._tags)
         if retired:
-            self._m["batch_occupancy"].set(
-                float(self._active.sum()) / self._ec.max_slots,
-                self._tags)
+            with self._cv:
+                self._publish_occupancy_locked()
         return True

@@ -1,0 +1,90 @@
+"""Host-side KV block-pool accounting for the paged engine.
+
+The device side (``models/generate.py`` paged programs) only sees flat
+pool rows and block tables; WHICH blocks a sequence owns is pure host
+bookkeeping, kept here. Block 0 is the scratch block — never allocated,
+the redirect target for retired slots and pad writes — so the usable
+pool is ``num_blocks - 1`` blocks.
+
+Thread-safety: the engine's scheduler thread is the only allocator
+caller; ``stats``-style readers tolerate a torn read (ints). No lock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class BlockPool:
+    """Free-list allocator over the shared KV block pool."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("paged KV pool needs >= 2 blocks "
+                             "(block 0 is scratch)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free: List[int] = list(range(1, num_blocks))
+        # Membership twin of the free list: the double-free guard must
+        # not cost a list scan per freed block (retirement runs on the
+        # scheduler thread between decode steps).
+        self._free_set = set(self._free)
+        self._freed_total = 0
+        self._alloc_total = 0
+
+    # ------------------------------------------------------------ alloc
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (scratch excluded)."""
+        return self.num_blocks - 1
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def used(self) -> int:
+        return self.capacity - len(self._free)
+
+    def occupancy(self) -> float:
+        return self.used() / self.capacity if self.capacity else 0.0
+
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to hold ``tokens`` logical positions."""
+        return max(0, -(-int(tokens) // self.block_size))
+
+    def can_fit(self, tokens: int) -> bool:
+        """Whether ``tokens`` positions could EVER fit (vs the whole
+        pool) — admission rejects impossible requests up front instead
+        of parking them forever."""
+        return self.blocks_for(tokens) <= self.capacity
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` blocks, or None (allocation is all-or-nothing so a
+        half-admitted sequence never holds blocks it cannot use)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out, self._free = self._free[:n], self._free[n:]
+        self._free_set.difference_update(out)
+        self._alloc_total += n
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b == 0 or b >= self.num_blocks:
+                raise ValueError(f"freeing invalid block {b}")
+            if b in self._free_set:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)
+        self._free_set.update(blocks)
+        self._freed_total += len(blocks)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "kv_blocks_total": self.capacity,
+            "kv_blocks_used": self.used(),
+            "kv_block_occupancy": round(self.occupancy(), 4),
+            "kv_blocks_alloc_total": self._alloc_total,
+            "kv_blocks_freed_total": self._freed_total,
+        }

@@ -31,6 +31,39 @@ from typing import Any, Dict, Iterator, List, Optional
 from ray_tpu.serve.llm.engine import EngineConfig, InflightBatchEngine
 
 
+class _EngineStream:
+    """Iterator over one engine request's chunks with an EXPLICIT
+    ``close()`` that cancels the request. The bare engine generator
+    only reaches its cancel-on-abandon ``finally`` once started; a
+    stream the consumer drops before pulling a single chunk (e.g. an
+    SSE client that connects and immediately disconnects) would leak
+    its slot/KV blocks without this wrapper."""
+
+    def __init__(self, engine: InflightBatchEngine, req_id: str):
+        self._engine = engine
+        self._req_id = req_id
+        self._gen = engine.stream(req_id)
+
+    def __iter__(self) -> Iterator[List[int]]:
+        return self
+
+    def __next__(self) -> List[int]:
+        return next(self._gen)
+
+    def close(self) -> None:
+        # Cancel FIRST: close() usually arrives from another thread
+        # (stream_cancel RPC) while __next__ is blocked inside drain —
+        # generator.close() then raises 'generator already executing'
+        # and must not gate the engine-side cleanup (cancel is
+        # thread-safe and idempotent; the running drain sees the
+        # request disappear and the generator winds down).
+        self._engine.cancel(self._req_id)
+        try:
+            self._gen.close()
+        except ValueError:   # mid-__next__ in another thread
+            pass
+
+
 def _ensure_metrics_reporter() -> None:
     """One metrics-push thread per replica process. start_reporter is
     idempotent-per-process (and joined on shutdown), so this is just a
@@ -95,10 +128,12 @@ class LLMReplica:
         return {"tokens": tokens}
 
     def generate_stream(self, request: Any) -> Iterator[List[int]]:
-        """Generator of token chunks (the handle's streaming path)."""
+        """Generator of token chunks (the handle's streaming path);
+        closing the stream (client disconnect) cancels the engine
+        request and frees its slot / KV blocks."""
         req = normalize_request(request)
         rid = self._engine.submit(req["prompt"], req["n"], req["seed"])
-        return self._engine.stream(rid)
+        return _EngineStream(self._engine, rid)
 
     # Decoupled submit/poll API: the high-QPS client path (one collect
     # RPC serves every session parked on this replica).
@@ -112,6 +147,9 @@ class LLMReplica:
     def collect(self, req_ids: List[str]):
         return self._engine.collect(req_ids)
 
+    def cancel(self, req_id: str) -> bool:
+        return self._engine.cancel(req_id)
+
     def serve_stats(self) -> Dict[str, Any]:
         return self._engine.stats()
 
@@ -124,15 +162,153 @@ class LLMReplica:
             eng.stop()
 
 
+class _PrefillBatcher:
+    """Micro-batch concurrent prefill calls into ONE compiled program
+    run (``prefill_slots``): callers arriving within
+    ``prefill_batch_window_ms`` of each other whose prompts share a
+    bucket ride the same [N, bucket] matmul — the first caller becomes
+    the LEADER, waits out the window (skipped when the batch fills),
+    runs the program, and hands each follower its row. Batch size is
+    rounded up to a power of two (dummy rows pad the remainder) so XLA
+    compiles once per (bucket, pow2) instead of once per occupancy."""
+
+    def __init__(self, params, cfg, ec: EngineConfig):
+        self._params = params
+        self._cfg = cfg
+        self._ec = ec
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._waiting: List[Dict[str, Any]] = []   # queued entries
+        self._leader = False
+
+    @staticmethod
+    def _pow2(n: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
+    def run(self, prompt: List[int], bucket: int,
+            seed: int) -> Any:
+        """Blocking: returns (first_token int, kv {"k","v"} for THIS
+        prompt, [L, 1, bucket, H, Dh]). Every caller loops as a
+        POTENTIAL leader: whoever finds no leader serves ONE batch
+        round and hands leadership back, so under sustained arrivals
+        leadership rotates (the first caller of a busy period is not
+        stuck serving everyone else's batches until a momentary drain)
+        and a waiter can never strand leaderless."""
+        import time as _time
+
+        entry = {"prompt": prompt, "bucket": bucket, "seed": seed,
+                 "done": threading.Event(), "out": None, "err": None}
+        deadline = _time.monotonic() + _PREFILL_FOLLOW_TIMEOUT_S
+        with self._cv:
+            self._waiting.append(entry)
+            self._cv.notify_all()
+        while not entry["done"].is_set():
+            with self._cv:
+                if entry["done"].is_set():
+                    break
+                if self._leader or entry not in self._waiting:
+                    # A round is in flight (possibly computing OUR
+                    # batch — once taken, the entry leaves the queue):
+                    # park briefly and re-check rather than leading an
+                    # empty round in a tight loop.
+                    self._cv.wait(0.05)
+                    if _time.monotonic() > deadline:
+                        try:
+                            self._waiting.remove(entry)
+                        except ValueError:
+                            pass
+                        if not entry["done"].is_set():
+                            raise TimeoutError(
+                                "prefill batch never served us")
+                    continue
+                self._leader = True
+            try:
+                self._serve_one_round()
+            finally:
+                with self._cv:
+                    self._leader = False
+                    self._cv.notify_all()
+        if entry["err"] is not None:
+            raise entry["err"]
+        return entry["out"]
+
+    def _serve_one_round(self) -> None:
+        """One batch round: wait out the batching window for the oldest
+        waiter's bucket, take up to a batch of its peers, run them."""
+        import time as _time
+
+        window = max(0.0, self._ec.prefill_batch_window_ms / 1e3)
+        cap = max(1, self._ec.prefill_batch_size)
+        with self._cv:
+            if not self._waiting:
+                return
+            bucket = self._waiting[0]["bucket"]
+            deadline = _time.monotonic() + window
+            while len([e for e in self._waiting
+                       if e["bucket"] == bucket]) < cap:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            batch = [e for e in self._waiting
+                     if e["bucket"] == bucket][:cap]
+            for e in batch:
+                self._waiting.remove(e)
+        if not batch:
+            return
+        try:
+            self._run_batch(batch)
+        except Exception as e:  # noqa: BLE001 — fan the failure out
+            for e2 in batch:
+                e2["err"] = e
+                e2["done"].set()
+
+    def _run_batch(self, batch: List[Dict[str, Any]]) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models.generate import prefill_slots
+
+        bucket = batch[0]["bucket"]
+        n = self._pow2(len(batch))
+        prompts = np.zeros((n, bucket), np.int32)
+        lens = np.ones((n,), np.int32)     # dummy rows: 1-token prompts
+        seeds = np.zeros((n,), np.int32)
+        for i, e in enumerate(batch):
+            prompts[i, :len(e["prompt"])] = e["prompt"]
+            lens[i] = len(e["prompt"])
+            seeds[i] = e["seed"]
+        firsts, kv = prefill_slots(
+            self._params, jnp.asarray(prompts), jnp.asarray(lens),
+            jnp.asarray(seeds), cfg=self._cfg,
+            temperature=self._ec.temperature, top_k=self._ec.top_k)
+        for i, e in enumerate(batch):
+            e["out"] = (int(firsts[i]),
+                        {"k": kv["k"][:, i:i + 1], "v": kv["v"][:, i:i + 1]})
+            e["done"].set()
+
+
+_PREFILL_FOLLOW_TIMEOUT_S = 120.0
+
+
 class PrefillReplica:
-    """Prompt-only pool: one prefill per call (prefill is one large
-    batched matmul — request-level concurrency across replicas is the
-    scaling axis here, driven by this pool's own autoscaler)."""
+    """Prompt-only pool. Prefill is one large batched matmul; two
+    scaling axes compose: request-level concurrency across replicas
+    (this pool's autoscaler) and — new — MICRO-BATCHING concurrent
+    calls within a replica into one [N, bucket] program run
+    (``prefill_batch_size`` > 1), which amortizes the weight streaming
+    the way the decode engine's slotted batch does."""
 
     def __init__(self, engine_config: Optional[Dict[str, Any]] = None):
         self._ec = EngineConfig.from_dict(engine_config)
         self._cfg, self._params = _build_model(self._ec)
         self._lock = threading.Lock()
+        self._batcher = _PrefillBatcher(self._params, self._cfg,
+                                        self._ec)
+        self._batched_total = 0
         _ensure_metrics_reporter()
 
     def _bucket_for(self, n: int) -> int:
@@ -146,7 +322,8 @@ class PrefillReplica:
     def prefill(self, request: Any) -> Dict[str, Any]:
         """Run the prompt, sample the first token, publish the KV block
         as device-object refs. Returns the handoff descriptor the router
-        forwards to the decode pool."""
+        forwards to the decode pool (now carrying the raw prompt so a
+        paged decode engine can recompute-resume after preemption)."""
         import jax.numpy as jnp
         import numpy as np
 
@@ -158,23 +335,30 @@ class PrefillReplica:
         if not prompt:
             raise ValueError("empty prompt")
         bucket = self._bucket_for(len(prompt))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :len(prompt)] = prompt
-        # jit dispatch is not thread-safe against itself for donated
-        # caches; prefill has no donation but serialize anyway — one
-        # prefill at a time per replica keeps the chip program simple.
-        with self._lock:
-            first, kv = prefill_slot(
-                self._params, jnp.asarray(padded),
-                jnp.int32(len(prompt)), jnp.int32(req["seed"]),
-                cfg=self._cfg, temperature=self._ec.temperature,
-                top_k=self._ec.top_k)
+        if self._ec.prefill_batch_size > 1:
+            first_token, kv = self._batcher.run(prompt, bucket,
+                                                req["seed"])
+            self._batched_total += 1
+        else:
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(prompt)] = prompt
+            # jit dispatch is not thread-safe against itself for donated
+            # caches; prefill has no donation but serialize anyway — one
+            # prefill at a time per replica keeps the chip program
+            # simple.
+            with self._lock:
+                first, kv = prefill_slot(
+                    self._params, jnp.asarray(padded),
+                    jnp.int32(len(prompt)), jnp.int32(req["seed"]),
+                    cfg=self._cfg, temperature=self._ec.temperature,
+                    top_k=self._ec.top_k)
+            first_token = int(first[0])
         return publish_kv(
-            kv, len(prompt), int(first[0]),
-            n=req["n"], seed=req["seed"])
+            kv, len(prompt), first_token,
+            n=req["n"], seed=req["seed"], prompt=list(prompt))
 
     def serve_stats(self) -> Dict[str, Any]:
-        return {}
+        return {"prefill_batched_total": self._batched_total}
 
     def check_health(self) -> bool:
         return True
@@ -199,7 +383,8 @@ class DecodeReplica:
         kv = adopt_kv(handoff)
         return self._engine.submit_prefilled(
             handoff["first_token"], kv, handoff["length"],
-            handoff.get("n"), handoff.get("seed") or 0)
+            handoff.get("n"), handoff.get("seed") or 0,
+            prompt=handoff.get("prompt"))
 
     def decode(self, handoff: Dict[str, Any]) -> Dict[str, Any]:
         """Blocking: the remaining tokens (2..n) for one handoff."""
@@ -211,13 +396,16 @@ class DecodeReplica:
 
     def decode_stream(self, handoff: Dict[str, Any]) -> Iterator[List[int]]:
         rid = self.submit_prefilled(handoff)
-        return self._engine.stream(rid)
+        return _EngineStream(self._engine, rid)
 
     def drain(self, req_id: str, max_wait_s: float = 0.5):
         return self._engine.drain(req_id, max_wait_s)
 
     def collect(self, req_ids: List[str]):
         return self._engine.collect(req_ids)
+
+    def cancel(self, req_id: str) -> bool:
+        return self._engine.cancel(req_id)
 
     def serve_stats(self) -> Dict[str, Any]:
         return self._engine.stats()

@@ -25,6 +25,30 @@ from ray_tpu.serve.llm.replicas import (
 _ROUTER_TIMEOUT_S = 600.0
 
 
+class _DisaggStream:
+    """First-token-then-decode-pool iterator with an EXPLICIT close():
+    ``stream_cancel`` on the router replica must cancel the decode
+    pool's stream even when the consumer never pulled a chunk (a
+    never-started generator's ``close()`` skips its ``finally``, which
+    would leak the decode engine request)."""
+
+    def __init__(self, first_token: int, inner):
+        self._first: Optional[List[int]] = [int(first_token)]
+        self._inner = inner
+
+    def __iter__(self) -> Iterator[List[int]]:
+        return self
+
+    def __next__(self) -> List[int]:
+        if self._first is not None:
+            out, self._first = self._first, None
+            return out
+        return next(self._inner)
+
+    def close(self) -> None:
+        self._inner.cancel()
+
+
 class LLMRouter:
     """Sequences one request across the pools. Mode is implied by which
     handles were bound: (prefill, decode) or a single combined pool."""
@@ -54,20 +78,21 @@ class LLMRouter:
     def generate_stream(self, request: Any) -> Iterator[List[int]]:
         """Streaming: yields token chunks. In disaggregated mode the
         first chunk is the prefill pool's token (the TTFT token); the
-        rest stream from the decode pool as produced."""
+        rest stream from the decode pool as produced. The prefill call
+        AND the decode-stream open run EAGERLY (at stream start, not
+        first pull) so overload/validation errors reach the ingress
+        before it commits a 200 — the shed contract holds for both
+        deployment modes, not just combined."""
         req = normalize_request(request)
         if self._llm is not None:
             return self._llm.generate_stream.remote_gen(req)
-        return self._stream_disagg(req)
-
-    def _stream_disagg(self, req: Dict[str, Any]) -> Iterator[List[int]]:
         handoff = self._prefill.prefill.remote(req).result(
             timeout=_ROUTER_TIMEOUT_S)
-        yield [handoff["first_token"]]
         if (handoff.get("n") or 2) <= 1:
-            return
-        for chunk in self._decode.decode_stream.remote_gen(handoff):
-            yield chunk
+            return iter([[handoff["first_token"]]])
+        return _DisaggStream(handoff["first_token"],
+                             self._decode.decode_stream.remote_gen(
+                                 handoff))
 
     def check_health(self) -> bool:
         return True

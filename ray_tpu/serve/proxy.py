@@ -1,192 +1,7 @@
-"""HTTP ingress proxy actor (reference: ``serve/_private/http_proxy.py:234``
-HTTPProxy / :415 HTTPProxyActor — uvicorn there, aiohttp here).
+"""Back-compat shim: the HTTP ingress moved to
+``ray_tpu.serve.ingress`` (async HTTP/SSE data path, admission
+control, per-tenant fairness). Import ``HTTPProxy`` from there."""
 
-Routes ``<route_prefix>/...`` to the deployment registered with that
-prefix. Request body (JSON or raw) and query params are passed to the
-user callable as a dict; the return value is JSON-encoded.
-"""
+from ray_tpu.serve.ingress.server import HTTPProxy  # noqa: F401
 
-from __future__ import annotations
-
-import asyncio
-import json
-import threading
-from typing import Optional
-
-
-class HTTPProxy:
-    def __init__(self, port: int):
-        self.port = port           # requested; 0 = ephemeral
-        self._bound_port: Optional[int] = None
-        self._ready = threading.Event()
-        # Route table + handles are cached so the data path does not hit
-        # the controller per request. Primary freshness source is the
-        # PUSH listener below (reference: proxies learn routes via
-        # LongPollClient pushes, http_proxy.py:137); the TTL poll is
-        # bootstrap + fallback.
-        self._routes = {}          # name -> route_prefix
-        self._routes_at = 0.0
-        self._handles = {}         # name -> DeploymentHandle
-        self._route_lock = threading.Lock()
-        self._thread = threading.Thread(target=self._serve_thread,
-                                        daemon=True, name="serve-http")
-        self._thread.start()
-        threading.Thread(target=self._routes_listener, daemon=True,
-                         name="serve-routes-longpoll").start()
-
-    _ROUTES_TTL_S = 1.0
-    _LISTEN_MAX_FAILURES = 8
-
-    def _routes_listener(self):
-        """Long-poll the controller's route-table channel: every proxy
-        learns of deploys/deletes within one notify (reference:
-        http_state.py pushes route tables to all node proxies)."""
-        import ray_tpu
-        from ray_tpu.serve.controller import CONTROLLER_NAME
-
-        version = 0
-        failures = 0
-        while True:
-            try:
-                ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
-                updates = ray_tpu.get(
-                    ctrl.listen_for_change.remote({"routes": version},
-                                                  25.0), timeout=35)
-            except Exception:
-                failures += 1
-                if failures >= self._LISTEN_MAX_FAILURES:
-                    return   # controller gone (serve.shutdown)
-                import time as _time
-
-                _time.sleep(1.0)
-                continue
-            failures = 0
-            if "routes" in updates:
-                version, routes = updates["routes"]
-                self._install_routes(routes)
-
-    def _install_routes(self, routes):
-        import time as _time
-
-        with self._route_lock:
-            self._routes = dict(routes)
-            self._routes_at = _time.time()
-            dropped = [h for n, h in self._handles.items()
-                       if n not in routes]
-            self._handles = {n: h for n, h in self._handles.items()
-                             if n in routes}
-        for h in dropped:
-            # Stop the dropped handle's push listener — the controller
-            # is alive, so the bounded-failure exit would never fire and
-            # the thread (plus one 25 s long-poll stream) would leak per
-            # deleted deployment.
-            try:
-                h.stop()
-            except Exception:
-                pass
-
-    def _route_table(self):
-        import time as _time
-
-        import ray_tpu
-        from ray_tpu.serve.controller import CONTROLLER_NAME
-
-        now = _time.time()
-        with self._route_lock:
-            if self._routes and now - self._routes_at < self._ROUTES_TTL_S:
-                return dict(self._routes)
-        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
-        deployments = ray_tpu.get(ctrl.list_deployments.remote())
-        routes = {name: info["config"].get("route_prefix")
-                  for name, info in deployments.items()}
-        self._install_routes(routes)
-        return dict(routes)
-
-    def _handle_for(self, name: str):
-        from ray_tpu.serve.handle import DeploymentHandle
-
-        with self._route_lock:
-            h = self._handles.get(name)
-            if h is None:
-                h = self._handles[name] = DeploymentHandle(name)
-        return h
-
-    def ready(self) -> bool:
-        if not self._ready.wait(timeout=20):
-            raise RuntimeError("HTTP proxy failed to start")
-        return True
-
-    def bound_port(self) -> int:
-        """The actually-bound port (differs from the requested one when
-        it was taken — e.g. per-node proxies of a single-host test
-        cluster all asking for the same port)."""
-        self.ready()
-        return self._bound_port
-
-    # --------------------------------------------------------------- server
-
-    def _serve_thread(self):
-        asyncio.run(self._serve())
-
-    async def _serve(self):
-        from aiohttp import web
-
-        app = web.Application()
-        app.router.add_route("*", "/{tail:.*}", self._handle)
-        runner = web.AppRunner(app)
-        await runner.setup()
-        try:
-            site = web.TCPSite(runner, "127.0.0.1", self.port)
-            await site.start()
-        except OSError:
-            # Requested port in use: fall back to an ephemeral port
-            # (callers discover it via bound_port()).
-            site = web.TCPSite(runner, "127.0.0.1", 0)
-            await site.start()
-        self._bound_port = site._server.sockets[0].getsockname()[1]
-        self._ready.set()
-        while True:
-            await asyncio.sleep(3600)
-
-    async def _handle(self, request):
-        from aiohttp import web
-
-        path = "/" + request.match_info["tail"]
-        loop = asyncio.get_running_loop()
-
-        def route_and_call(payload):
-            routes = self._route_table()
-            target: Optional[str] = None
-            best_len = -1
-            for name, prefix in routes.items():
-                if prefix and (path == prefix or
-                               path.startswith(prefix.rstrip("/") + "/")) \
-                        and len(prefix) > best_len:
-                    target, best_len = name, len(prefix)
-            if target is None:
-                return None, 404
-            resp = self._handle_for(target).remote(payload)
-            return resp.result(timeout=60), 200
-
-        body = await request.read()
-        payload = {"path": path,
-                   "query": dict(request.query),
-                   "method": request.method}
-        if body:
-            try:
-                payload["json"] = json.loads(body)
-            except (json.JSONDecodeError, UnicodeDecodeError):
-                payload["body"] = body
-
-        try:
-            result, code = await loop.run_in_executor(
-                None, route_and_call, payload)
-        except Exception as e:  # noqa: BLE001
-            return web.json_response({"error": str(e)}, status=500)
-        if code == 404:
-            return web.json_response(
-                {"error": f"no deployment routes {path}"}, status=404)
-        try:
-            return web.json_response(result)
-        except TypeError:
-            return web.Response(body=str(result).encode())
+__all__ = ["HTTPProxy"]
