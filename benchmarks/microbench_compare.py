@@ -14,6 +14,11 @@ Toggles:
                     first-class store object (arena-staged zero-copy
                     put/get, by-reference same-process handoff) vs the
                     legacy pickle-via-host path
+  profiler          RAY_TPU_PROFILER_ALWAYS_ON — the in-process
+                    sampling profiler running at its default rate in
+                    every process vs off (the ISSUE 12 overhead bound:
+                    tasks_sync/tasks_async must stay >=0.95x with the
+                    sampler on)
 
 Run:  python benchmarks/microbench_compare.py [rounds] [out.json] [toggle]
 """
@@ -40,6 +45,12 @@ TOGGLES = {
                "rides in-band in the pickle stream, paying device->host->"
                "pickle->arena on put and arena->unpickle->host->device on "
                "get)"),
+    "profiler": ("RAY_TPU_PROFILER_ALWAYS_ON",
+                 "in-process sampling profiler running at the default "
+                 "rate (profiler_hz) in every process vs off — the "
+                 "overhead A/B behind the 'always-available flamegraphs' "
+                 "claim; on/off >=0.95x on tasks_sync/tasks_async is "
+                 "the acceptance bound"),
 }
 
 

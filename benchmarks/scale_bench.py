@@ -22,7 +22,16 @@ Measures, on one GCS process:
   GCS).
 
 Prints one JSON line per metric. Run: python benchmarks/scale_bench.py
-[N_tasks] [K_actors] [--gcs-out-of-process {0,1}].
+[N_tasks] [K_actors] [--gcs-out-of-process {0,1}]
+[--profile-submit OUT.speedscope.json].
+
+``--profile-submit`` runs the in-process sampling profiler
+(ray_tpu._private.profiler) over the DRIVER for exactly the infeasible-
+queue submit phase and writes the capture as a speedscope document (+ a
+.folded sibling): the evidence artifact for the SCALE_r08 attack on the
+per-driver submit ceiling — it attributes the caller-thread hot path
+(TaskSpec construction / arg pickling / submit flush) the next perf PR
+targets.
 
 ``--gcs-out-of-process`` pins the GCS topology for the run (1 = the GCS
 in its own subprocess/interpreter, 0 = in the head process — the
@@ -114,6 +123,7 @@ def main():
     argv = sys.argv[1:]
     args = []
     gcs_oop = None
+    profile_out = None
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -126,6 +136,12 @@ def main():
                 v = argv[i]
             gcs_oop = v.strip().lower() not in ("0", "false", "off") \
                 if v else True
+        elif a.startswith("--profile-submit"):
+            _, eq, v = a.partition("=")
+            if not eq and i + 1 < len(argv):
+                i += 1
+                v = argv[i]
+            profile_out = v or "PROFILE_driver_submit.speedscope.json"
         else:
             args.append(a)
         i += 1
@@ -161,9 +177,44 @@ def main():
         # Warm the feasible path (lease + worker up).
         assert ray_tpu.get(feasible.remote()) == 42
 
+        prof = None
+        if profile_out:
+            from ray_tpu._private.profiler import get_profiler
+
+            prof = get_profiler()
+            # Denser than the 67 Hz default: the submit phase lasts a
+            # few seconds and the capture is the whole point here.
+            prof_started = prof.start(hz=250)
+            prof.reset()
         t0 = time.perf_counter()
         queued = [never.remote() for _ in range(n_tasks)]
         dt = time.perf_counter() - t0
+        if prof is not None:
+            cap = prof.collect(reset=True)
+            if prof_started:
+                # Leave an always-on sampler running (we only borrowed a
+                # window of it); stop only the one we started.
+                prof.stop()
+            cap.update({"kind": "driver", "phase": "submit",
+                        "bench": "scale_bench infeasible-queue submit",
+                        "n_tasks": n_tasks})
+            from ray_tpu._private.profiler import (
+                folded_lines, speedscope_document)
+
+            doc = speedscope_document(
+                [cap], name=f"scale_bench driver submit phase "
+                            f"({n_tasks} tasks, {dt:.2f}s)")
+            with open(profile_out, "w") as f:
+                json.dump(doc, f)
+            folded_path = profile_out.rsplit(".speedscope.json", 1)[0] \
+                + ".folded"
+            with open(folded_path, "w") as f:
+                f.write("\n".join(folded_lines([cap])) + "\n")
+            print(json.dumps({
+                "metric": "driver_submit_profile",
+                "value": cap["samples"], "unit": "samples",
+                "hz": cap["hz"], "out": profile_out,
+                "folded": folded_path}), flush=True)
         # The submit loop is driver-side async: fallback waves are still
         # draining into the GCS. Barrier on the full queue so the next
         # probe measures placement behind a SETTLED n_tasks-deep queue.

@@ -357,11 +357,48 @@ _d("agent_stack_timeout_s", 5.0,
    "Bound on one cluster-wide in-band stack capture (ray_tpu stack): "
    "per-worker dump_stacks RPCs are fanned out in parallel and workers "
    "that cannot answer within it are reported as errors, not waited on.")
+_d("profiler_hz", 67,
+   "Sampling rate of the in-process profiler (ray_tpu profile): the "
+   "daemon sampler thread walks sys._current_frames() this many times "
+   "per second. 67 Hz is the py-spy-style default — off the 100 Hz "
+   "beat of periodic loops, cheap enough to leave on (the 'profiler' "
+   "toggle in benchmarks/microbench_compare.py is the overhead A/B).")
+_d("profiler_max_frames", 64,
+   "Frames kept per sampled stack (leaf side wins; deeper stacks get a "
+   "<truncated> root marker). Bounds folded-key size under recursion.")
+_d("profiler_max_stacks", 2048,
+   "Distinct folded stacks held by the profiler's per-process table. A "
+   "new stack arriving at a full table evicts the smallest-count entry "
+   "and accounts its samples in profiler_dropped_samples_total — deep/"
+   "churning workloads see a truncated-but-honest profile, never "
+   "unbounded memory.")
+_d("profiler_always_on", False,
+   "Start the background sampler in every ray_tpu process at init "
+   "(always-available flamegraphs; `ray_tpu profile` then reads a "
+   "window of the running sampler instead of starting one). Also the "
+   "overhead-A/B toggle: RAY_TPU_PROFILER_ALWAYS_ON=1 vs 0 in "
+   "benchmarks/microbench_compare.py must stay >=0.95x on tasks_sync/"
+   "tasks_async.")
+_d("log_follow_interval_s", 1.0,
+   "Poll interval of `ray_tpu logs -f` / state.get_log(follow=True): "
+   "each tick re-reads every matched log file from its byte-offset "
+   "cursor (tail -f semantics over the agent fan-in).")
 
 # --- tpu --------------------------------------------------------------------
 _d("tpu_chips_per_host", 4,
    "Chips driven by one host on the modeled pod (v4/v5p default).")
 _d("tpu_topology", "", "Override slice topology string, e.g. '2x2x1'.")
+
+# --- tracing ----------------------------------------------------------------
+_d("trace_sample_rate", 1.0,
+   "Head-based span sampling for high-rate traffic: the probability "
+   "that a NEW trace root (serve ingress/handle request, driver-side "
+   "root span) is kept. Decided ONCE at the root and propagated with "
+   "the trace context, so a trace is never half-kept; FAILURE spans "
+   "(errored requests, ingress sheds) are ALWAYS emitted regardless of "
+   "the decision, while routine consumer cancels sample like 'ok'. "
+   "1.0 keeps everything (the default); task events themselves are "
+   "never sampled out — only spans.")
 
 # --- serve ------------------------------------------------------------------
 _d("serve_handle_stats_rpc", False,

@@ -2344,6 +2344,108 @@ class GcsServer:
         self._agent_fanout(conn, msg_id, "agent_logs", p, nodes,
                            timeout_s=10.0)
 
+    def _h_profile(self, conn, p, msg_id):
+        """Cluster-wide sampling-profile capture (`ray_tpu profile`):
+        fan the ``profile`` verb out to every node agent (each samples
+        its node manager + workers) AND every connected driver, while
+        the GCS-hosting process samples ITSELF — all windows run
+        concurrently, so a whole-cluster capture costs one window of
+        wall time. Replies with a FLAT list of per-process profiles the
+        CLI/dashboard merge into one speedscope document.
+
+        Filters: ``node_id`` narrows the node fan-out; ``worker_id``/
+        ``actor_id`` narrow to matching workers (and skip drivers/GCS);
+        ``driver`` limits to driver processes; ``gcs`` to the GCS's own
+        process (the latter also serves a bare bootstrap-address conn —
+        the GCS-subprocess self-profile path needs no registration)."""
+        p = dict(p or {})
+        duration_s = min(600.0, max(0.05,
+                                    float(p.get("duration_s", 5.0))))
+        payload = {"duration_s": duration_s, "hz": p.get("hz"),
+                   "mode": p.get("mode", "wall")}
+        worker_scoped = bool(p.get("worker_id") or p.get("actor_id"))
+        only_driver = bool(p.get("driver"))
+        only_gcs = bool(p.get("gcs"))
+        targets = []
+        if not only_driver and not only_gcs:
+            # Same payload OBJECT as the driver fan-out when no worker
+            # filter applies: payloads group by identity below, and two
+            # groups would fan out sequentially — two windows of wall
+            # time instead of one. (With a worker filter, drivers are
+            # excluded entirely, so there is only ever one group.)
+            node_payload = payload
+            if worker_scoped:
+                node_payload = dict(payload)
+                for k in ("worker_id", "actor_id"):
+                    if p.get(k):
+                        node_payload[k] = p[k]
+            for nid, nconn in self._agent_nodes(p.get("node_id")):
+                targets.append((("node", nid, node_payload), nconn))
+        if not worker_scoped and not only_gcs \
+                and not p.get("node_id"):
+            with self._sched_lock:
+                drivers = [(c.meta.get("client_id"), c)
+                           for c in self._clients.values()
+                           if c.meta.get("role") == "driver"
+                           and not c.closed]
+            for cid, dconn in drivers:
+                targets.append((("driver", cid, payload), dconn))
+        include_self = only_gcs or (not worker_scoped and not only_driver
+                                    and not p.get("node_id"))
+
+        def run():
+            from ray_tpu._private import profiler
+
+            self_box: Dict[str, Any] = {}
+            self_thread = None
+            if include_self:
+                def self_profile():
+                    self_box["out"] = profiler.profile_self(
+                        duration_s=duration_s, hz=payload["hz"],
+                        mode=payload["mode"], kind="gcs")
+
+                self_thread = threading.Thread(
+                    target=self_profile, daemon=True,
+                    name="rtpu-gcs-selfprof")
+                self_thread.start()
+            out: List[Dict[str, Any]] = []
+            # Per-target payloads differ (worker filters ride the node
+            # fan-out only), so group by payload identity; in practice
+            # that is at most two groups, fanned out back to back under
+            # one shared deadline budget. 3x duration: the in-process
+            # topology shares ONE profiler between GCS, NM, and driver,
+            # and their self-windows serialize.
+            grouped: Dict[int, list] = {}
+            for (kind, key, pl), c in targets:
+                grouped.setdefault(id(pl), (pl, []))[1].append(
+                    ((kind, key), c))
+            for pl, group in grouped.values():
+                for (kind, key), ok, reply in protocol.fanout_requests(
+                        group, "profile", pl,
+                        3.0 * duration_s + 20.0):
+                    if not ok:
+                        out.append({"kind": kind,
+                                    "node_id" if kind == "node"
+                                    else "client_id": key,
+                                    "error": reply})
+                    elif kind == "node":
+                        out.extend((reply or {}).get("processes") or [])
+                    else:
+                        out.append(reply or {})
+            if self_thread is not None:
+                self_thread.join(timeout=3.0 * duration_s + 15.0)
+                if self_box.get("out"):
+                    out.insert(0, self_box["out"])
+            try:
+                conn.reply(msg_id, out)
+            except Exception:
+                pass
+
+        # Off this conn's serve thread: the fan-out blocks for the whole
+        # profile window.
+        threading.Thread(target=run, daemon=True,
+                         name="rtpu-gcs-profile").start()
+
     def _h_flight_dump(self, conn, p, msg_id):
         """Trigger a flight-recorder dump on every node (the gang
         supervisor calls this when it declares slice death, so each

@@ -42,6 +42,11 @@ CONTROL_PLANE = (
     "ray_tpu/_private/worker.py",
     "ray_tpu/_private/worker_main.py",
     "ray_tpu/_private/protocol.py",
+    # The sampling profiler runs a daemon thread inside EVERY process
+    # of the cluster and answers over control-plane listener threads —
+    # an unbounded wait or a lock inversion here wedges the very
+    # process someone is trying to diagnose.
+    "ray_tpu/_private/profiler.py",
     "ray_tpu/_private/device_objects.py",
     "ray_tpu/parallel/collective.py",
     "ray_tpu/train/worker_group.py",

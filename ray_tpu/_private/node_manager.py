@@ -1267,7 +1267,7 @@ class NodeManager:
                     except OSError:
                         pass
             elif mtype in ("collect_stacks", "agent_logs",
-                           "flight_snapshot"):
+                           "flight_snapshot", "profile"):
                 self._handle_agent(conn, mtype, payload, msg_id)
             elif mtype == "flight_dump":
                 # Fan-out notify (gang supervisor declared slice death):
@@ -1963,7 +1963,7 @@ class NodeManager:
                 # postmortems).
                 self.agent.record_task_events(payload or [])
             elif mtype in ("collect_stacks", "agent_logs",
-                           "flight_snapshot", "flight_dump"):
+                           "flight_snapshot", "flight_dump", "profile"):
                 # The agent endpoint is also directly addressable on the
                 # node (same transport the GCS fan-in uses).
                 self._handle_agent(conn, mtype, payload, msg_id)

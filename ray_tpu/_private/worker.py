@@ -522,6 +522,13 @@ class CoreWorker:
         # under a 200-actor churn burst those boot RPCs alone saturate
         # the head process.
 
+        # Always-on sampling profiler (profiler_always_on): one
+        # idempotent daemon sampler per process, stopped in
+        # disconnect() — init()/shutdown() cycles never stack samplers.
+        from ray_tpu._private import profiler as profiler_mod
+
+        profiler_mod.maybe_start_always_on()
+
     def _ensure_lease_mgr(self):
         if self._lease_mgr is None and self._lease_wanted \
                 and not self._closed:
@@ -541,6 +548,16 @@ class CoreWorker:
     # ----------------------------------------------------------- plumbing
 
     def _on_gcs_msg(self, conn, mtype, payload, msg_id):
+        if mtype == "profile":
+            # Driver-side sampling profile (`ray_tpu profile --driver`):
+            # answered over THIS conn but off its serve thread — the
+            # window lasts seconds, and this thread must keep delivering
+            # GCS replies (including, possibly, the very profile request
+            # this driver itself issued).
+            threading.Thread(
+                target=self._reply_profile, args=(conn, msg_id, payload),
+                daemon=True, name="rtpu-driver-profile").start()
+            return
         if mtype == "revoke_lease":
             lm = self._lease_mgr
             if lm is not None:
@@ -569,6 +586,28 @@ class CoreWorker:
             try:
                 stream.flush()
             except Exception:
+                pass
+
+    def _reply_profile(self, conn, msg_id, payload):
+        from ray_tpu._private import profiler
+
+        p = payload or {}
+        try:
+            out = profiler.profile_self(
+                duration_s=float(p.get("duration_s", 5.0)),
+                hz=p.get("hz"),
+                mode=p.get("mode", "wall"),
+                kind=self.role,
+                node_id=self.node_id,
+                client_id=self.client_id,
+            )
+            conn.reply(msg_id, out)
+        except protocol.ConnectionClosed:
+            pass
+        except Exception as e:
+            try:
+                conn.reply_error(msg_id, f"{type(e).__name__}: {e}")
+            except protocol.ConnectionClosed:
                 pass
 
     def _own_nm_address(self) -> Optional[str]:
@@ -642,6 +681,12 @@ class CoreWorker:
 
         try:
             metrics_mod.stop_reporter()
+        except Exception:
+            pass
+        try:
+            from ray_tpu._private import profiler as profiler_mod
+
+            profiler_mod.stop_always_on()
         except Exception:
             pass
         try:

@@ -143,6 +143,17 @@ class WorkerExecutor:
             # as the out-of-band fallback.
             self._reply_stacks(conn, msg_id)
             return
+        if mtype == "profile":
+            # In-band sampling profile (the data path behind `ray_tpu
+            # profile`): received on the listener thread — so a wedged
+            # main thread still profiles — but the bounded window runs
+            # on a short-lived thread (the sampler is a daemon thread
+            # either way; the listener must keep delivering cancels and
+            # exits while the window is open).
+            threading.Thread(
+                target=self._reply_profile, args=(conn, msg_id, payload),
+                daemon=True, name="rtpu-profile-req").start()
+            return
         if mtype == "run_actor_task":
             # Pin args the moment the spec lands here: the task may sit in
             # this actor's queue for a long time, and the caller's refs may
@@ -199,6 +210,34 @@ class WorkerExecutor:
             })
         except protocol.ConnectionClosed:
             pass
+
+    def _reply_profile(self, conn, msg_id, payload):
+        from ray_tpu._private import profiler
+
+        p = payload or {}
+        try:
+            cur = self._current_task_id
+            actor_id = None
+            if self.actor_spec is not None:
+                actor_id = self.actor_spec.actor_id.binary().hex()
+            out = profiler.profile_self(
+                duration_s=float(p.get("duration_s", 5.0)),
+                hz=p.get("hz"),
+                mode=p.get("mode", "wall"),
+                kind="worker",
+                node_id=self.node_id,
+                worker_id=self.worker_id.hex(),
+                actor_id=actor_id,
+                current_task_id=cur.hex() if cur else None,
+            )
+            conn.reply(msg_id, out)
+        except protocol.ConnectionClosed:
+            pass
+        except Exception as e:
+            try:
+                conn.reply_error(msg_id, f"{type(e).__name__}: {e}")
+            except protocol.ConnectionClosed:
+                pass
 
     def _on_direct_disconnect(self, conn):
         # The lease holder hung up. Only tell the NM when NO direct conn

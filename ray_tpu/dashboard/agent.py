@@ -56,20 +56,60 @@ def current_stacks() -> List[Dict[str, Any]]:
     return out
 
 
-def tail_file(path: str, max_lines: int, max_bytes: int = 1 << 20
-              ) -> List[str]:
-    """Last ``max_lines`` lines of ``path`` (bounded read from the end)."""
+def tail_file_at(path: str, max_lines: int, max_bytes: int = 1 << 20
+                 ) -> "tuple[List[str], int]":
+    """Last ``max_lines`` lines of ``path`` plus the byte offset the
+    read CONSUMED TO (bounded read from the end). The offset is the
+    stat'ed size the read was capped at — never a re-stat after the
+    read — so a follow cursor seeded from it skips nothing the tail
+    didn't show, even if the file grew mid-read."""
     try:
         size = os.path.getsize(path)
         with open(path, "rb") as f:
             f.seek(max(0, size - max_bytes))
-            data = f.read(max_bytes)
+            # Cap at the stat'ed size: bytes appended after the stat
+            # belong to the NEXT cursor read, not this tail.
+            data = f.read(min(size, max_bytes))
     except OSError:
-        return []
+        return [], 0
     lines = data.decode("utf-8", "replace").splitlines()
     if size > max_bytes and lines:
         lines = lines[1:]   # first line is likely truncated mid-way
-    return lines[-max_lines:]
+    return lines[-max_lines:], size
+
+
+def tail_file(path: str, max_lines: int, max_bytes: int = 1 << 20
+              ) -> List[str]:
+    """Last ``max_lines`` lines of ``path`` (bounded read from the end)."""
+    return tail_file_at(path, max_lines, max_bytes)[0]
+
+
+def read_file_from(path: str, offset: int, max_bytes: int = 1 << 20
+                   ) -> "tuple[List[str], int]":
+    """Complete lines of ``path`` from byte ``offset`` (the log-follow
+    cursor read): returns ``(lines, next_offset)``. Only whole lines are
+    consumed — a partial trailing line stays unread until its newline
+    lands (unless it alone exceeds ``max_bytes``). An offset past EOF
+    (truncation/rotation) restarts from 0."""
+    try:
+        size = os.path.getsize(path)
+        if offset > size:
+            offset = 0   # file was truncated/rotated under the cursor
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(max_bytes)
+    except OSError:
+        return [], offset
+    if not data:
+        return [], offset
+    end = data.rfind(b"\n")
+    if end < 0:
+        if len(data) < max_bytes:
+            return [], offset   # partial line: wait for its newline
+        end = len(data) - 1     # overlong line: forced flush
+    chunk = data[:end + 1]
+    return chunk.decode("utf-8", "replace").splitlines(), \
+        offset + len(chunk)
 
 
 class FlightRecorder:
@@ -229,12 +269,21 @@ class NodeAgent:
                  actor_id: Optional[str] = None,
                  ident: Optional[str] = None,
                  stream: Optional[str] = None,
-                 lines: int = 100) -> List[Dict[str, Any]]:
+                 lines: int = 100,
+                 offsets: Optional[Dict[str, int]] = None
+                 ) -> List[Dict[str, Any]]:
         """Tail the matching workers' log files. ``worker_id``/
         ``actor_id`` match on hex prefixes (``ident`` matches either —
         the CLI's one-argument form); no filter = every worker on the
         node. Matching is symmetric-prefix so a FULL id query still
-        finds a dead-worker row recovered from a 12-hex filename."""
+        finds a dead-worker row recovered from a 12-hex filename.
+
+        ``offsets`` switches to cursor reads (the log-follow path):
+        each entry is read from its byte offset (a path absent from the
+        dict — a worker that appeared mid-follow — starts at 0), and
+        entries with no new bytes are omitted. Every entry carries
+        ``path``/``next_offset`` so the follower's next poll resumes
+        where this one stopped."""
         def _match(row_id: Optional[str], q: str) -> bool:
             return bool(row_id) and (row_id.startswith(q)
                                      or q.startswith(row_id))
@@ -251,13 +300,24 @@ class NodeAgent:
             for stream_name, path in sorted(row["log_paths"].items()):
                 if stream and stream_name != stream:
                     continue
+                if offsets is not None:
+                    off = int(offsets.get(path, 0))
+                    entry_lines, next_off = read_file_from(path, off)
+                    if not entry_lines and next_off == off \
+                            and path in offsets:
+                        continue   # follow tick with nothing new
+                else:
+                    entry_lines, next_off = tail_file_at(
+                        path, max_lines=lines)
                 out.append({
                     "node_id": self._nm.node_id,
                     "worker_id": row["worker_id"],
                     "actor_id": row["actor_id"],
                     "pid": row["pid"],
                     "stream": stream_name,
-                    "lines": tail_file(path, max_lines=lines),
+                    "path": path,
+                    "next_offset": next_off,
+                    "lines": entry_lines,
                 })
         return out
 
@@ -293,6 +353,69 @@ class NodeAgent:
             "workers": workers,
         }
 
+    # ------------------------------------------------------------ profiles
+
+    def collect_profiles(self, duration_s: float = 5.0,
+                         hz: Optional[float] = None, mode: str = "wall",
+                         worker_id: Optional[str] = None,
+                         actor_id: Optional[str] = None
+                         ) -> Dict[str, Any]:
+        """One bounded sampling-profile window across this node: fan the
+        ``profile`` verb out to every live worker's listener thread
+        (exactly the ``collect_stacks`` transport, so a rank wedged in a
+        collective still answers) while the node manager's own process
+        samples itself CONCURRENTLY — total wall time is one window,
+        not one per process. Stragglers are abandoned, not waited on."""
+        from ray_tpu._private import profiler, protocol
+
+        nm = self._nm
+        with nm._lock:
+            targets = [((w.worker_id.hex(), w.proc.pid,
+                         w.actor_id.hex() if w.actor_id else None),
+                        w.conn)
+                       for w in nm._workers.values()
+                       if w.conn is not None and not w.conn.closed
+                       and w.proc.poll() is None]
+        if worker_id:
+            targets = [(k, c) for k, c in targets
+                       if k[0].startswith(worker_id)]
+        if actor_id:
+            targets = [(k, c) for k, c in targets
+                       if k[2] and k[2].startswith(actor_id)]
+        payload = {"duration_s": duration_s, "hz": hz, "mode": mode}
+        # NM self-profile on a helper thread so its window overlaps the
+        # workers' windows; skipped when the query names one worker.
+        self_box: Dict[str, Any] = {}
+        self_thread = None
+        if not worker_id and not actor_id:
+            def self_profile():
+                self_box["out"] = profiler.profile_self(
+                    duration_s=duration_s, hz=hz, mode=mode,
+                    kind="node_manager", node_id=nm.node_id)
+
+            self_thread = threading.Thread(
+                target=self_profile, daemon=True, name="rtpu-nm-selfprof")
+            self_thread.start()
+        processes = []
+        for (wid, pid, aid), ok, reply in protocol.fanout_requests(
+                targets, "profile", payload,
+                duration_s + max(5.0, float(duration_s))):
+            if ok:
+                processes.append(reply or {})
+            else:
+                processes.append({"kind": "worker", "worker_id": wid,
+                                  "pid": pid, "actor_id": aid,
+                                  "node_id": nm.node_id, "error": reply})
+        if self_thread is not None:
+            # 3x + margin: in the in-process topology this profiler is
+            # shared with the GCS's and the driver's self-profile
+            # windows, and windows serialize — the NM's may queue
+            # behind two full windows.
+            self_thread.join(timeout=3.0 * duration_s + 10.0)
+            if self_box.get("out"):
+                processes.insert(0, self_box["out"])
+        return {"node_id": nm.node_id, "processes": processes}
+
     # ------------------------------------------------------------ dispatch
 
     def handle(self, mtype: str, payload: Optional[dict]) -> Any:
@@ -302,6 +425,13 @@ class NodeAgent:
         if mtype == "collect_stacks":
             return self.collect_stacks(
                 timeout_s=float(p.get("timeout_s", 5.0)))
+        if mtype == "profile":
+            return self.collect_profiles(
+                duration_s=float(p.get("duration_s", 5.0)),
+                hz=p.get("hz"),
+                mode=p.get("mode", "wall"),
+                worker_id=p.get("worker_id"),
+                actor_id=p.get("actor_id"))
         if mtype == "agent_logs":
             if p.get("list"):
                 return self.list_logs()
@@ -310,7 +440,8 @@ class NodeAgent:
                 actor_id=p.get("actor_id"),
                 ident=p.get("id"),
                 stream=p.get("stream"),
-                lines=int(p.get("lines", 100)))
+                lines=int(p.get("lines", 100)),
+                offsets=p.get("offsets"))
         if mtype == "flight_snapshot":
             return {"node_id": self._nm.node_id,
                     "events": self.recorder.snapshot(),

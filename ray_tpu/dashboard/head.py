@@ -120,6 +120,7 @@ class DashboardHead:
                            self._serve_applications_put)
         app.router.add_get("/api/logs", self._logs)
         app.router.add_get("/api/stacks", self._stacks)
+        app.router.add_get("/api/profile", self._profile)
         app.router.add_get("/api/{what}", self._api)
         app.router.add_get("/metrics", self._metrics)
         runner = web.AppRunner(app)
@@ -216,6 +217,50 @@ class DashboardHead:
 
         data = await loop.run_in_executor(None, fetch)
         return web.Response(text=json.dumps(data, default=repr),
+                            content_type="application/json")
+
+    async def _profile(self, request):
+        """Cluster-wide sampling profile (the REST face of `ray_tpu
+        profile`). Query: duration_s / hz / mode=wall|cpu / node_id /
+        worker_id / actor_id / driver=1 / gcs=1 /
+        format=speedscope|folded|raw (default speedscope — the merged
+        one-document view)."""
+        from aiohttp import web
+        from ray_tpu._private import profiler
+        from ray_tpu._private import worker as worker_mod
+
+        q = request.query
+        payload = {"duration_s": float(q.get("duration_s", 5.0)),
+                   "mode": q.get("mode", "wall")}
+        for k in ("node_id", "worker_id", "actor_id"):
+            if q.get(k):
+                payload[k] = q[k]
+        if q.get("hz"):
+            payload["hz"] = float(q["hz"])
+        for flag in ("driver", "gcs"):
+            if q.get(flag):
+                payload[flag] = True
+        loop = asyncio.get_running_loop()
+
+        def fetch():
+            w = worker_mod.require_worker()
+            return w.gcs.request(
+                "profile", payload,
+                timeout=3.0 * payload["duration_s"] + 30.0)
+
+        processes = await loop.run_in_executor(None, fetch)
+        fmt = q.get("format", "speedscope")
+        ok = [p for p in processes
+              if isinstance(p, dict) and not p.get("error")]
+        if fmt == "folded":
+            return web.Response(
+                text="\n".join(profiler.folded_lines(ok)) + "\n",
+                content_type="text/plain")
+        if fmt == "raw":
+            return web.Response(text=json.dumps(processes, default=repr),
+                                content_type="application/json")
+        doc = profiler.speedscope_document(ok)
+        return web.Response(text=json.dumps(doc),
                             content_type="application/json")
 
     async def _serve_applications_get(self, request):

@@ -12,11 +12,12 @@ from ray_tpu.experimental.state.api import (  # noqa: F401
     list_objects,
     list_placement_groups,
     list_tasks,
+    profile,
     summarize_tasks,
 )
 
 __all__ = [
     "list_actors", "list_tasks", "list_nodes", "list_objects",
     "list_placement_groups", "list_jobs", "summarize_tasks", "get_actor",
-    "list_logs", "get_log", "dump_stacks",
+    "list_logs", "get_log", "dump_stacks", "profile",
 ]

@@ -9,7 +9,8 @@ Usage: ``python -m ray_tpu <command>``
   summary tasks
   timeline [--output FILE]
   stack   [--node PREFIX] [--timeout S]   # in-band cluster-wide stacks
-  logs    [WORKER|ACTOR] [--lines N]      # per-worker log fan-in
+  logs    [WORKER|ACTOR] [--lines N] [-f] # per-worker log fan-in / follow
+  profile [--duration S] [--hz N]         # cluster-wide flamegraphs
 """
 
 from __future__ import annotations
@@ -277,9 +278,29 @@ def cmd_logs(args) -> int:
     """Tail a worker's (or actor's) stdout/stderr cluster-wide
     (reference: ``ray logs``): the head fans the request to the
     per-node agents, which read the session log files — including for
-    workers that already died."""
+    workers that already died. ``-f`` follows with ``tail -f``
+    semantics (bounded poll loop over agent byte-offset cursors;
+    Ctrl-C exits cleanly)."""
     ray_tpu = _connect(args.address)
     from ray_tpu._private import worker as worker_mod
+
+    if args.follow:
+        from ray_tpu.experimental import state
+
+        gen = state.get_log(ident=args.target, stream=args.stream,
+                            lines=args.lines, follow=True,
+                            interval_s=args.interval)
+        try:
+            for entry in gen:
+                who = f"{entry['worker_id'][:12]}/{entry['stream']}"
+                for ln in entry.get("lines") or []:
+                    print(f"({who}) {ln}", flush=True)
+        except KeyboardInterrupt:
+            pass   # clean Ctrl-C: stop following, exit 0
+        finally:
+            gen.close()
+            ray_tpu.shutdown()
+        return 0
 
     payload: dict = {"lines": args.lines}
     if args.target:
@@ -308,6 +329,60 @@ def cmd_logs(args) -> int:
         if not shown:
             print("no matching worker logs", file=sys.stderr)
             return 1
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Cluster-wide sampling profile (reference: the dashboard's
+    per-worker py-spy verb, ``ray_tpu``-style: in-band, no ptrace):
+    one bounded window across every process — workers, drivers, node
+    managers, the GCS subprocess — merged into ONE speedscope document
+    (or folded flamegraph lines) covering the whole cluster."""
+    ray_tpu = _connect(args.address)
+    from ray_tpu._private import profiler
+    from ray_tpu.experimental import state
+
+    try:
+        processes = state.profile(
+            duration_s=args.duration, hz=args.hz, mode=args.mode,
+            node_id=args.node, worker_id=args.worker,
+            actor_id=args.actor, driver=args.driver, gcs=args.gcs)
+        errors = [p for p in processes
+                  if isinstance(p, dict) and p.get("error")]
+        ok = [p for p in processes
+              if isinstance(p, dict) and not p.get("error")]
+        for p in errors:
+            print(f"profile error ({p.get('kind', '?')} "
+                  f"{p.get('node_id') or p.get('client_id') or ''}): "
+                  f"{p['error']}", file=sys.stderr)
+        if not ok:
+            print("no profiles captured", file=sys.stderr)
+            return 1
+        if args.format == "folded":
+            out = "\n".join(profiler.folded_lines(ok)) + "\n"
+            if args.output:
+                with open(args.output, "w") as f:
+                    f.write(out)
+                print(f"wrote folded profile of {len(ok)} processes "
+                      f"to {args.output}")
+            else:
+                sys.stdout.write(out)
+        else:
+            doc = profiler.speedscope_document(
+                ok, name=f"ray_tpu cluster profile "
+                         f"({args.duration:g}s @ {args.hz or 'default'}"
+                         f"Hz, {args.mode})")
+            path = args.output or \
+                f"profile-{int(time.time())}.speedscope.json"
+            with open(path, "w") as f:
+                json.dump(doc, f)
+            print(f"wrote merged speedscope profile of {len(ok)} "
+                  f"processes ({len(doc['profiles'])} threads) to "
+                  f"{path}")
+            print("open at https://www.speedscope.app/ or `speedscope "
+                  f"{path}`")
     finally:
         ray_tpu.shutdown()
     return 0
@@ -489,8 +564,39 @@ def main(argv=None) -> int:
                    help="worker or actor id (hex prefix); omit for all")
     p.add_argument("--lines", type=int, default=100)
     p.add_argument("--stream", choices=["stdout", "stderr"], default=None)
+    p.add_argument("-f", "--follow", action="store_true",
+                   help="tail -f semantics: keep polling the agents "
+                        "for new lines until Ctrl-C")
+    p.add_argument("--interval", type=float, default=None,
+                   help="follow poll interval in seconds "
+                        "(default: log_follow_interval_s)")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("profile")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="profile window in seconds (default 10)")
+    p.add_argument("--hz", type=float, default=None,
+                   help="sampling rate (default: profiler_hz)")
+    p.add_argument("--mode", choices=["wall", "cpu"], default="wall")
+    p.add_argument("--format", choices=["folded", "speedscope"],
+                   default="speedscope")
+    p.add_argument("--output", "-o", default=None,
+                   help="output path (speedscope default: "
+                        "profile-<ts>.speedscope.json; folded default: "
+                        "stdout)")
+    p.add_argument("--node", default=None,
+                   help="restrict to one node id (hex prefix)")
+    p.add_argument("--worker", default=None,
+                   help="restrict to one worker id (hex prefix)")
+    p.add_argument("--actor", default=None,
+                   help="restrict to one actor id (hex prefix)")
+    p.add_argument("--driver", action="store_true",
+                   help="profile only connected driver processes")
+    p.add_argument("--gcs", action="store_true",
+                   help="profile only the GCS-hosting process")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("status")
     p.add_argument("--address", default=None)

@@ -41,10 +41,14 @@ def _aid(replica) -> str:
 
 
 class DeploymentResponse:
-    def __init__(self, ref, resubmit=None, on_done=None):
+    def __init__(self, ref, resubmit=None, on_done=None, span=None):
         self._ref = ref
         self._resubmit = resubmit
         self._on_done = on_done
+        # The handle-root PendingSpan: emitted once, when the OUTCOME is
+        # known (here, at result()) — an errored request's trace is then
+        # always kept even when head-based sampling dropped it.
+        self._span = span
 
     def result(self, timeout: Optional[float] = None):
         """Block for the response. If the serving replica died
@@ -59,16 +63,39 @@ class DeploymentResponse:
         try:
             while True:
                 try:
-                    return ray_tpu.get(self._ref, timeout=timeout)
+                    out = ray_tpu.get(self._ref, timeout=timeout)
+                    self._finish_span("ok")
+                    return out
                 except (exceptions.RayActorError,
                         exceptions.WorkerCrashedError):
                     if self._resubmit is None or attempts <= 0:
+                        self._finish_span("error")
                         raise
                     attempts -= 1
                     time.sleep(0.2)
                     self._ref = self._resubmit()
+                except exceptions.GetTimeoutError:
+                    raise   # not terminal: the caller may result() again
+                except BaseException:
+                    self._finish_span("error")
+                    raise
         finally:
             self._done()
+
+    def _finish_span(self, status: str):
+        sp, self._span = self._span, None
+        if sp is not None:
+            sp.finish(status)
+
+    def __del__(self):
+        # Fire-and-forget (a response never result()ed): emit the handle
+        # root at GC with the outcome unobserved, so the replica's task
+        # event never dangles off an unwritten parent span. finish() is
+        # idempotent and never raises, safe at interpreter teardown.
+        try:
+            self._finish_span("ok")
+        except Exception:
+            pass
 
     def _done(self):
         cb, self._on_done = self._on_done, None
@@ -89,11 +116,14 @@ class DeploymentResponseGenerator:
     replica generator only advances when the consumer asks)."""
 
     def __init__(self, replica, stream_id: str,
-                 timeout_s: Optional[float] = None, on_done=None):
+                 timeout_s: Optional[float] = None, on_done=None,
+                 span=None):
         self._replica = replica
         self._sid = stream_id
         self._timeout = timeout_s
         self._on_done = on_done
+        self._span = span
+        self._status: Optional[str] = None
         self._exhausted = False
 
     def __iter__(self):
@@ -116,10 +146,11 @@ class DeploymentResponseGenerator:
             # would live on for a consumer that is gone. (If the error
             # CAME from the replica it already dropped the stream and
             # the cancel is a cheap no-op.)
+            self._status = "error"
             self.cancel()
             raise
         if out.get("done"):
-            self._finish()
+            self._finish("ok")
             raise StopIteration
         return out["item"]
 
@@ -131,7 +162,7 @@ class DeploymentResponseGenerator:
             self._replica.stream_cancel.remote(self._sid)
         except Exception:
             pass
-        self._finish()
+        self._finish(self._status or "cancelled")
 
     # ``close`` so nested streams propagate cancellation: a replica
     # whose own streaming method wraps ANOTHER deployment's remote_gen
@@ -140,8 +171,11 @@ class DeploymentResponseGenerator:
     # engine decoding for a consumer that is gone.
     close = cancel
 
-    def _finish(self):
+    def _finish(self, status: str = "ok"):
         self._exhausted = True
+        sp, self._span = self._span, None
+        if sp is not None:
+            sp.finish(status)
         cb, self._on_done = self._on_done, None
         if cb is not None:
             try:
@@ -328,32 +362,40 @@ class DeploymentHandle:
         with self._lock:
             return a if self._load_of(a) <= self._load_of(b) else b
 
-    def _submit(self, method: str, args, kwargs, fresh: bool = False):
+    def _submit(self, method: str, args, kwargs, fresh: bool = False,
+                span=None):
         from ray_tpu.util import tracing
 
         if fresh:
             self._refresh(force=True)
         replica = self._pick()
         done = self._note_submit(replica)
-        # The handle hop is a span: the replica's handle_request task
-        # submits inside it, so its task event parents under this hop
-        # and `ray_tpu timeline` shows caller -> handle -> replica ->
-        # (engine / KV transfer) as one connected trace.
-        with tracing.span(
+        # The handle hop is a span — and the TRACE ROOT for serve
+        # traffic, where the head-based sampling decision is made
+        # (trace_sample_rate): the replica's handle_request task submits
+        # inside it, so its task event parents under this hop and
+        # inherits the decision. The span's emission waits for the
+        # request OUTCOME (DeploymentResponse.result), so an errored
+        # request is always kept. A resubmission after replica death
+        # reuses the original span — one request, one root.
+        if span is None:
+            span = tracing.PendingSpan(
                 f"serve.handle.{self.deployment_name}.{method}",
                 kind="serve_handle",
                 attrs={"deployment": self.deployment_name,
-                       "method": method}):
+                       "method": method})
+        with span.active():
             ref = replica.handle_request.remote(method, args, kwargs)
-        return ref, done
+        return ref, done, span
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        ref, done = self._submit(self._method, args, kwargs)
+        ref, done, span = self._submit(self._method, args, kwargs)
         return DeploymentResponse(
             ref,
             resubmit=lambda: self._submit(self._method, args, kwargs,
-                                          fresh=True)[0],
-            on_done=done)
+                                          fresh=True, span=span)[0],
+            on_done=done,
+            span=span)
 
     def remote_gen(self, *args, _item_timeout_s: Optional[float] = None,
                    **kwargs) -> DeploymentResponseGenerator:
@@ -372,21 +414,24 @@ class DeploymentHandle:
 
         replica = self._pick()
         done = self._note_submit(replica)
+        span = tracing.PendingSpan(
+            f"serve.handle.{self.deployment_name}.{method}",
+            kind="serve_handle",
+            attrs={"deployment": self.deployment_name,
+                   "method": method, "streaming": True})
         try:
-            with tracing.span(
-                    f"serve.handle.{self.deployment_name}.{method}",
-                    kind="serve_handle",
-                    attrs={"deployment": self.deployment_name,
-                           "method": method, "streaming": True}):
+            with span.active():
                 start_ref = replica.handle_request_stream.remote(
                     method, args, kwargs)
             sid = ray_tpu.get(start_ref, timeout=_STREAM_START_TIMEOUT_S)
         except BaseException:
             done()
+            span.finish("error")
             raise
         return DeploymentResponseGenerator(replica, sid,
                                            timeout_s=item_timeout_s,
-                                           on_done=done)
+                                           on_done=done,
+                                           span=span)
 
 
 class _MethodCaller:
@@ -395,12 +440,13 @@ class _MethodCaller:
         self._method = method
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        ref, done = self._handle._submit(self._method, args, kwargs)
+        ref, done, span = self._handle._submit(self._method, args, kwargs)
         return DeploymentResponse(
             ref,
             resubmit=lambda: self._handle._submit(
-                self._method, args, kwargs, fresh=True)[0],
-            on_done=done)
+                self._method, args, kwargs, fresh=True, span=span)[0],
+            on_done=done,
+            span=span)
 
     def remote_gen(self, *args, _item_timeout_s: Optional[float] = None,
                    **kwargs) -> DeploymentResponseGenerator:

@@ -29,6 +29,7 @@ import asyncio
 import concurrent.futures
 import json
 import threading
+import time
 from typing import Any, Dict, Optional
 
 _TENANT_DEFAULT = "default"
@@ -303,11 +304,20 @@ class HTTPProxy:
     async def _admit(self, request):
         """Run admission; returns (tenant, None) or (tenant, response)."""
         from ray_tpu.exceptions import ServeOverloadedError
+        from ray_tpu.util import tracing
 
         tenant = self._tenant_of(request)
         try:
             await self._admission.acquire(tenant)
         except ServeOverloadedError as e:
+            # Shed requests are ALWAYS traced (status != "ok" bypasses
+            # head-based span sampling): under overload, the sheds are
+            # exactly the requests an operator needs to see.
+            tracing.emit_span(
+                f"serve.ingress.shed{request.path}", kind="serve_ingress",
+                start=time.time(), status="shed",
+                attrs={"tenant": tenant,
+                       "reason": getattr(e, "reason", "overloaded")})
             return tenant, self._overload_response(e)
         self._m["requests"].inc(1, dict(self._tags, tenant=tenant))
         return tenant, None

@@ -67,6 +67,24 @@ def current() -> Optional[Dict[str, str]]:
     return _current.get()
 
 
+def _sample_root() -> bool:
+    """Head-based sampling decision, made ONCE when a trace roots
+    (``trace_sample_rate``): the decision rides the context to every
+    descendant span — across task hops via the spec's trace_ctx — so a
+    trace is never half-kept. Spans whose status is not "ok" (errored
+    requests, ingress sheds) are emitted regardless; see emit_span."""
+    from ray_tpu._private.config import config
+
+    rate = float(config.trace_sample_rate)
+    if rate >= 1.0:
+        return True   # default: no entropy draw on the hot path
+    if rate <= 0.0:
+        return False
+    import random
+
+    return random.random() < rate
+
+
 def for_submit() -> Optional[Dict[str, Optional[str]]]:
     """Context to attach to an outgoing task spec: continues the active
     trace (the submitting task's span becomes the parent). A driver-side
@@ -78,7 +96,11 @@ def for_submit() -> Optional[Dict[str, Optional[str]]]:
     ctx = _current.get()
     if ctx is None:
         return None
-    return {"trace_id": ctx["trace_id"], "parent_span_id": ctx["span_id"]}
+    out: Dict[str, Optional[str]] = {
+        "trace_id": ctx["trace_id"], "parent_span_id": ctx["span_id"]}
+    if ctx.get("sampled") is False:
+        out["sampled"] = False   # only ship the non-default decision
+    return out
 
 
 def activate(trace_ctx: Optional[Dict[str, Any]],
@@ -88,10 +110,12 @@ def activate(trace_ctx: Optional[Dict[str, Any]],
     ``deactivate``."""
     if not trace_ctx:
         trace_ctx = {"trace_id": _new_trace_id(),
-                     "parent_span_id": None}
+                     "parent_span_id": None,
+                     "sampled": _sample_root()}
     return _current.set({"trace_id": trace_ctx.get("trace_id"),
                          "span_id": span_id,
-                         "parent_span_id": trace_ctx.get("parent_span_id")})
+                         "parent_span_id": trace_ctx.get("parent_span_id"),
+                         "sampled": trace_ctx.get("sampled", True)})
 
 
 def deactivate(token: contextvars.Token) -> None:
@@ -134,15 +158,27 @@ def emit_span(name: str, kind: str, start: float,
               attrs: Optional[Dict[str, Any]] = None,
               span_id: Optional[str] = None,
               trace_id: Optional[str] = None,
-              parent_span_id: Any = _UNSET) -> None:
+              parent_span_id: Any = _UNSET,
+              sampled: Optional[bool] = None) -> None:
     """Append one completed span to the task-event stream. By default
     the span is a child of the active context (task span or enclosing
     ``span()``); with no active context it roots a fresh trace. Explicit
     trace_id/parent_span_id override the context (``span()`` passes its
     own identity — by emit time its contextvar is already reset). Never
-    raises — tracing must not break the operation it observes."""
+    raises — tracing must not break the operation it observes.
+
+    Sampling: a span belonging to a sampled-OUT trace (head-based,
+    ``trace_sample_rate``) is dropped here — UNLESS its status marks a
+    failure: errored requests and ingress sheds are always kept. A
+    consumer-initiated "cancelled" (SSE client closing its tab) is
+    ROUTINE on high-rate streaming traffic — the very traffic the knob
+    exists for — so it samples like "ok"."""
     try:
         ctx = _current.get()
+        if sampled is None:
+            sampled = ctx.get("sampled", True) if ctx else _sample_root()
+        if not sampled and status in ("ok", "cancelled"):
+            return
         sid = span_id or new_span_id()
         ev = {
             "task_id": sid,
@@ -181,10 +217,12 @@ def span(name: str, kind: str = "span",
     sid = new_span_id()
     tid = ctx["trace_id"] if ctx else _new_trace_id()
     parent = ctx["span_id"] if ctx else None
+    sampled = ctx.get("sampled", True) if ctx else _sample_root()
     token = _current.set({
         "trace_id": tid,
         "span_id": sid,
         "parent_span_id": parent,
+        "sampled": sampled,
     })
     start = time.time()
     status = "ok"
@@ -196,7 +234,62 @@ def span(name: str, kind: str = "span",
     finally:
         _current.reset(token)
         emit_span(name, kind, start, status=status, attrs=attrs,
-                  span_id=sid, trace_id=tid, parent_span_id=parent)
+                  span_id=sid, trace_id=tid, parent_span_id=parent,
+                  sampled=sampled)
+
+
+class PendingSpan:
+    """A root-capable span whose OUTCOME is known later than its body —
+    the serve request shape: the handle submits inside the span (so the
+    replica task parents under it and inherits the sampling decision),
+    but ok/error is only known when the response resolves. ``finish``
+    emits exactly once with the terminal status; an errored request is
+    therefore always kept even when its trace was sampled out."""
+
+    __slots__ = ("name", "kind", "attrs", "sid", "trace_id", "parent",
+                 "sampled", "start", "_emitted")
+
+    def __init__(self, name: str, kind: str = "span",
+                 attrs: Optional[Dict[str, Any]] = None):
+        ctx = _current.get()
+        self.name, self.kind, self.attrs = name, kind, attrs
+        self.sid = new_span_id()
+        if ctx is not None:
+            self.trace_id = ctx["trace_id"]
+            self.parent = ctx["span_id"]
+            self.sampled = ctx.get("sampled", True)
+        else:
+            self.trace_id = _new_trace_id()
+            self.parent = None
+            self.sampled = _sample_root()
+        self.start = time.time()
+        self._emitted = False
+
+    @contextlib.contextmanager
+    def active(self):
+        """Make this span the current context (submissions inside become
+        its children and inherit the sampling decision)."""
+        token = _current.set({
+            "trace_id": self.trace_id,
+            "span_id": self.sid,
+            "parent_span_id": self.parent,
+            "sampled": self.sampled,
+        })
+        try:
+            yield self
+        finally:
+            _current.reset(token)
+
+    def finish(self, status: str = "ok") -> None:
+        """Emit the span with its terminal status (idempotent; never
+        raises — span bookkeeping must not break the request path)."""
+        if self._emitted:
+            return
+        self._emitted = True
+        emit_span(self.name, self.kind, self.start, status=status,
+                  attrs=self.attrs, span_id=self.sid,
+                  trace_id=self.trace_id, parent_span_id=self.parent,
+                  sampled=self.sampled)
 
 
 def _maybe_flush() -> None:

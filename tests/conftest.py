@@ -51,6 +51,10 @@ LOCKDEP_MODULES = {
     "test_scheduler_scale",
     "test_gcs_fault_tolerance",
     "test_actor_leases",
+    # The profiler's sampler/window/table locks run inside every
+    # process the cluster owns (and its fan-in crosses the NM/GCS agent
+    # paths) — witness its lock graph wherever its tests drive it.
+    "test_profiler",
 }
 
 

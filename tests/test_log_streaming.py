@@ -79,3 +79,89 @@ def test_actor_prints_reach_driver(capfd):
         _drain_until(capfd, "actor-says-MARKER42")
     finally:
         ray_tpu.shutdown()
+
+
+# ------------------------------------------------- follow (tail -f)
+# (ISSUE 12 satellite: bounded poll loop over agent byte-offset
+# cursors — the carried ROADMAP log-streaming item)
+
+
+def test_get_log_follow_streams_new_lines_in_order():
+    """state.get_log(follow=True): the generator yields the initial
+    tail, then ONLY new lines as they land — ordered, no duplicates —
+    and close() stops it cleanly."""
+    import threading
+
+    from ray_tpu.experimental import state
+
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        class Chatty:
+            def __init__(self):
+                self._stop = False
+
+                def loop():
+                    i = 0
+                    while not self._stop and i < 200:
+                        print(f"FOLLOW_MARK {i}", flush=True)
+                        i += 1
+                        time.sleep(0.1)
+
+                threading.Thread(target=loop, daemon=True).start()
+
+            def ping(self):
+                return 1
+
+            def stop(self):
+                self._stop = True
+                return True
+
+        a = Chatty.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=30) == 1
+        time.sleep(0.8)
+
+        gen = state.get_log(actor_id=a._actor_id.hex(),
+                            stream="stdout", follow=True,
+                            interval_s=0.25)
+        seen = []
+        deadline = time.time() + 30
+        for entry in gen:
+            assert entry["stream"] == "stdout"
+            assert "path" in entry and "next_offset" in entry
+            seen += [ln for ln in entry.get("lines") or []
+                     if ln.startswith("FOLLOW_MARK")]
+            if len(seen) >= 10 or time.time() > deadline:
+                break
+        gen.close()
+        assert len(seen) >= 10, seen
+        nums = [int(ln.split()[1]) for ln in seen]
+        assert nums == sorted(nums), "lines reordered"
+        assert len(set(nums)) == len(nums), "duplicate lines"
+        assert ray_tpu.get(a.stop.remote(), timeout=10)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_follow_cursor_reads_only_complete_lines(tmp_path):
+    """The agent's cursor read never splits a line: a partially-written
+    trailing line stays unread until its newline lands."""
+    from ray_tpu.dashboard.agent import read_file_from
+
+    p = tmp_path / "w.log"
+    p.write_bytes(b"one\ntwo\npart")
+    lines, off = read_file_from(str(p), 0)
+    assert lines == ["one", "two"]
+    assert off == len(b"one\ntwo\n")
+    # Nothing new and still no newline: cursor holds.
+    lines, off2 = read_file_from(str(p), off)
+    assert lines == [] and off2 == off
+    # The newline lands: the held-back line is delivered once.
+    with open(p, "ab") as f:
+        f.write(b"ial\nthree\n")
+    lines, off3 = read_file_from(str(p), off)
+    assert lines == ["partial", "three"]
+    # Truncation/rotation under the cursor restarts from 0.
+    p.write_bytes(b"fresh\n")
+    lines, off4 = read_file_from(str(p), off3)
+    assert lines == ["fresh"] and off4 == len(b"fresh\n")

@@ -1,0 +1,378 @@
+"""Cluster-wide sampling profiler (ISSUE 12): folded/speedscope
+goldens, bounded-table eviction, sampler lifecycle across
+init()/shutdown() cycles, the wedged-collective-rank capture, and the
+GCS-subprocess self-profile over the bootstrap address."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import profiler as profiler_mod
+from ray_tpu._private.config import config
+from ray_tpu._private.profiler import (
+    SamplingProfiler,
+    folded_lines,
+    speedscope_document,
+)
+from ray_tpu.experimental import state
+
+
+def _wait_for(cond, timeout, msg):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _profiler_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "rtpu-profiler" and t.is_alive()]
+
+
+def _golden_busy_loop(stop):
+    x = 0
+    while not stop.is_set():
+        x = (x + 1) % 1000003
+    return x
+
+
+# --------------------------------------------------------------- goldens
+
+
+def test_folded_and_speedscope_golden():
+    """A busy thread's hot function shows up in the folded output, and
+    the merged speedscope document is schema-shaped and JSON-clean."""
+    stop = threading.Event()
+    t = threading.Thread(target=_golden_busy_loop, args=(stop,),
+                         daemon=True, name="golden-busy")
+    t.start()
+    prof = SamplingProfiler()
+    try:
+        assert prof.start(hz=200)
+        time.sleep(0.6)
+        out = prof.collect(reset=True)
+    finally:
+        prof.stop()
+        stop.set()
+        t.join(timeout=5)
+    assert out["samples"] > 0 and out["pid"] == os.getpid()
+    busy = [s for s in out["stacks"] if "_golden_busy_loop" in s]
+    assert busy, out["stacks"]
+    # Folded keys lead with the thread name, frames root->leaf.
+    assert any(s.startswith("golden-busy;") for s in busy), busy
+
+    proc = dict(out, kind="worker", node_id="ab" * 6)
+    lines = folded_lines([proc])
+    assert lines and all(" " in ln for ln in lines)
+    label, _, rest = lines[0].partition(";")
+    assert label.startswith("worker node=")
+    assert lines[0].rsplit(" ", 1)[1].isdigit()
+
+    doc = speedscope_document([proc], name="golden")
+    assert doc["$schema"].endswith("file-format-schema.json")
+    assert doc["shared"]["frames"] and doc["profiles"]
+    for p in doc["profiles"]:
+        assert p["type"] == "sampled"
+        assert len(p["samples"]) == len(p["weights"])
+        assert p["endValue"] == sum(p["weights"])
+        for sample in p["samples"]:
+            for idx in sample:
+                assert 0 <= idx < len(doc["shared"]["frames"])
+    # One profile per (process, thread); the busy thread is among them.
+    assert any("golden-busy" in p["name"] for p in doc["profiles"])
+    json.loads(json.dumps(doc))   # JSON-clean end to end
+
+    # The /metrics counters moved: samples were recorded.
+    from ray_tpu.util.metrics import collect_samples
+
+    names = {s["name"]: s["value"] for s in collect_samples()}
+    assert names.get("profiler_samples_total", 0) >= out["samples"]
+
+
+def test_cpu_mode_counts_idle_leaves_separately():
+    """cpu mode: samples parked in blocking leaves (cv/event waits —
+    pure-Python leaves; a C-level sleep leaves no Python leaf frame to
+    classify) are accounted as idle, not attributed to the table."""
+    prof = SamplingProfiler()
+    stop = threading.Event()
+
+    def sleeper():
+        while not stop.is_set():
+            stop.wait(0.05)   # leaf frame: threading Condition.wait
+
+    t = threading.Thread(target=sleeper, daemon=True, name="idle-sleeper")
+    t.start()
+    try:
+        assert prof.start(hz=200, mode="cpu")
+        time.sleep(0.5)
+        out = prof.collect(reset=True)
+    finally:
+        prof.stop()
+        stop.set()
+        t.join(timeout=5)
+    assert out["mode"] == "cpu"
+    assert out["idle_samples"] > 0
+    assert not any(s.startswith("idle-sleeper;") for s in out["stacks"])
+
+
+# ------------------------------------------------------- bounded table
+
+
+def test_bounded_table_eviction_under_churning_stacks():
+    """Deep/churning stacks: the folded table never exceeds its bound;
+    evicted samples are accounted as dropped, never silently lost."""
+    old = config.get("profiler_max_stacks")
+    config.set("profiler_max_stacks", 16)
+    try:
+        prof = SamplingProfiler()
+        for i in range(200):
+            prof._add(f"churn;stack_{i:03d}", count=i + 1)
+        out = prof.collect()
+        assert len(out["stacks"]) <= 16
+        assert out["samples"] == sum(range(1, 201))
+        assert out["dropped"] > 0
+        # Accounting closes: kept + dropped == recorded.
+        assert sum(out["stacks"].values()) + out["dropped"] == \
+            out["samples"]
+        # Highest-count stacks survive (smallest-count eviction).
+        assert "churn;stack_199" in out["stacks"]
+    finally:
+        config.set("profiler_max_stacks", old)
+
+
+def test_deep_stack_truncated_with_marker():
+    old = config.get("profiler_max_frames")
+    config.set("profiler_max_frames", 8)
+    try:
+        prof = SamplingProfiler()
+        stop = threading.Event()
+        ready = threading.Event()
+
+        def deep(n):
+            if n > 0:
+                return deep(n - 1)
+            ready.set()
+            stop.wait(10)
+
+        t = threading.Thread(target=deep, args=(40,), daemon=True,
+                             name="deep-rec")
+        t.start()
+        assert ready.wait(5)
+        assert prof.start(hz=200)
+        time.sleep(0.3)
+        out = prof.collect(reset=True)
+        prof.stop()
+        stop.set()
+        t.join(timeout=5)
+        deep_stacks = [s for s in out["stacks"]
+                       if s.startswith("deep-rec;")]
+        assert deep_stacks
+        for s in deep_stacks:
+            frames = s.split(";")[1:]
+            assert len(frames) <= 10   # max_frames + truncation marker
+            assert "<truncated>" in frames[0]
+    finally:
+        config.set("profiler_max_frames", old)
+
+
+# ------------------------------------------------------------ lifecycle
+
+
+def test_sampler_start_stop_idempotent():
+    prof = SamplingProfiler()
+    before = len(_profiler_threads())
+    assert prof.start()
+    assert not prof.start()     # second start: no new thread
+    assert len(_profiler_threads()) == before + 1
+    prof.stop()
+    prof.stop()                 # idempotent
+    _wait_for(lambda: len(_profiler_threads()) == before, 5,
+              "sampler thread join")
+
+
+def test_always_on_no_thread_stacking_across_init_shutdown():
+    """profiler_always_on across init()/shutdown() cycles: exactly one
+    sampler while up, zero after shutdown — the PR 7 reporter-lifecycle
+    contract, mirrored (no thread stacking)."""
+    old = config.get("profiler_always_on")
+    config.set("profiler_always_on", True)
+    try:
+        for _ in range(2):
+            ray_tpu.init(num_cpus=1,
+                         object_store_memory=64 * 1024 * 1024)
+            assert len(_profiler_threads()) == 1
+            ray_tpu.shutdown()
+            _wait_for(lambda: len(_profiler_threads()) == 0, 5,
+                      "sampler joined on shutdown")
+    finally:
+        config.set("profiler_always_on", old)
+
+
+# ------------------------------------------------------- cluster capture
+
+
+@pytest.fixture
+def ray_cluster():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_cluster_profile_covers_every_process_kind(ray_cluster):
+    """One state.profile() window covers driver + node manager + GCS +
+    workers, and the merged speedscope document holds them all."""
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    assert ray_tpu.get([warm.remote() for _ in range(2)],
+                       timeout=60) == [1, 1]
+
+    @ray_tpu.remote
+    def spin(sec):
+        t0 = time.time()
+        x = 0
+        while time.time() - t0 < sec:
+            x += 1
+        return x
+
+    refs = [spin.remote(4.0) for _ in range(2)]
+    time.sleep(0.3)
+    t0 = time.time()
+    processes = state.profile(duration_s=1.0)
+    assert time.time() - t0 < 30
+    kinds = {p.get("kind") for p in processes if not p.get("error")}
+    assert {"gcs", "node_manager", "driver", "worker"} <= kinds, processes
+    workers = [p for p in processes if p.get("kind") == "worker"]
+    assert any("spin" in s for p in workers
+               for s in (p.get("stacks") or {})), \
+        "submit-phase hot path not attributed"
+    doc = speedscope_document(processes)
+    assert len(doc["profiles"]) >= len(
+        [p for p in processes if not p.get("error")])
+    ray_tpu.get(refs, timeout=60)
+
+
+def test_worker_scoped_profile_filters(ray_cluster):
+    @ray_tpu.remote
+    class P:
+        def ping(self):
+            return 1
+
+    a = P.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == 1
+    aid = a._actor_id.hex()
+    processes = state.profile(duration_s=0.3, actor_id=aid)
+    ok = [p for p in processes if not p.get("error")]
+    assert ok and all(p["kind"] == "worker" and p["actor_id"] == aid
+                      for p in ok), processes
+
+
+def test_wedged_collective_rank_still_profiles(ray_cluster):
+    """The wedge case: a rank blocked inside a collective (peer never
+    joins) still answers the profile verb — in-band, from its listener
+    thread — and the capture attributes the collective frames."""
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def join(self, world):
+            from ray_tpu.parallel import collective
+
+            collective.init_collective_group(
+                world, self.rank, backend="store",
+                group_name="prof_wedge")
+            return True
+
+        def reduce(self):
+            import numpy as np
+
+            from ray_tpu.parallel import collective
+
+            return collective.allreduce(
+                np.ones(4), group_name="prof_wedge").tolist()
+
+    r0, r1 = Rank.remote(0), Rank.remote(1)
+    assert ray_tpu.get([r0.join.remote(2), r1.join.remote(2)],
+                       timeout=60) == [True, True]
+    wedged_ref = r0.reduce.remote()   # rank 1 never calls reduce
+    time.sleep(1.5)                   # let rank 0 enter the op
+
+    t0 = time.time()
+    processes = state.profile(duration_s=1.0,
+                              actor_id=r0._actor_id.hex())
+    assert time.time() - t0 < 30      # bounded capture
+    ok = [p for p in processes if not p.get("error")]
+    assert ok, processes
+    wedged = [p for p in ok
+              if any("allreduce" in s or "_exchange" in s
+                     for s in (p.get("stacks") or {}))]
+    assert wedged, json.dumps(ok)[:2000]
+
+    from ray_tpu.parallel import collective
+
+    collective.poison_group("prof_wedge", "test teardown")
+    with pytest.raises(Exception):
+        ray_tpu.get(wedged_ref, timeout=30)
+
+
+# --------------------------------------- GCS subprocess self-profile
+
+
+def test_gcs_subprocess_self_profile_over_bootstrap_address():
+    """The out-of-process GCS profiles ITSELF: a bare conn to the
+    bootstrap address (no registration) asks for a gcs-scoped profile
+    and gets back a window sampled in the GCS's own interpreter."""
+    old = config.get("gcs_out_of_process")
+    config.set("gcs_out_of_process", True)
+    try:
+        ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+        from ray_tpu._private import protocol
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.require_worker()
+        gcs_pid = w.gcs.request("control_plane_stats",
+                                timeout=30)["gcs_process"]["pid"]
+        assert gcs_pid != os.getpid()
+        conn = protocol.connect(w.gcs_address, name="prof-probe")
+        try:
+            out = conn.request("profile",
+                               {"gcs": True, "duration_s": 0.5},
+                               timeout=30)
+        finally:
+            conn.close()
+        assert isinstance(out, list) and len(out) == 1, out
+        prof = out[0]
+        assert prof["kind"] == "gcs" and not prof.get("error")
+        assert prof["pid"] == gcs_pid          # its OWN interpreter
+        assert prof["samples"] > 0 and prof["stacks"]
+        # The GCS serve loop is what a healthy idle GCS looks like.
+        assert any("gcs" in s or "serve" in s or "wait" in s
+                   for s in prof["stacks"])
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            config.set("gcs_out_of_process", old)
+
+
+def test_profile_window_rearms_running_sampler_with_requested_knobs():
+    """An always-on sampler (wall @ default hz) must honor a window's
+    requested hz/mode — and resume its standing configuration after."""
+    prof = SamplingProfiler()
+    assert prof.start(hz=30, mode="wall")   # the standing always-on config
+    try:
+        out = prof.profile(duration_s=0.2, hz=200, mode="cpu")
+        assert out["mode"] == "cpu" and out["hz"] == 200.0
+        # Still running afterwards, restored to the standing knobs.
+        assert prof.running
+        assert prof._hz == 30.0 and prof._mode == "wall"
+    finally:
+        prof.stop()

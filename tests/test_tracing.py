@@ -251,3 +251,129 @@ def test_span_helpers_driverside(ray_cluster):
     names = {t["name"] for t in state.list_tasks()}
     assert "outer_op" not in names and "inner_op" not in names
     assert "outer_op" not in state.summarize_tasks()
+
+
+# ---------------------------------------------------- span sampling
+# (ISSUE 12 satellite: head-based trace_sample_rate, decided once per
+# request at the serve handle root and propagated with the context so a
+# trace is never half-kept; errored and shed requests always kept)
+
+
+_SPAN_KINDS = ("serve_handle", "serve_replica", "serve_ingress")
+
+
+def _serve_spans():
+    return [e for e in ray_tpu.timeline()
+            if e.get("kind") in _SPAN_KINDS]
+
+
+@pytest.fixture
+def sampled_out():
+    """trace_sample_rate=0 for the duration of the test (restored after
+    — the config registry is process-global)."""
+    from ray_tpu._private.config import config
+
+    config.set("trace_sample_rate", 0.0)
+    yield
+    config.set("trace_sample_rate", 1.0)
+
+
+def test_sampled_out_serve_round_trip_emits_zero_spans(
+        ray_cluster, sampled_out):
+    """With the root sampled out, NO span of the round trip is emitted —
+    not the handle root (driver side) and not the replica-side span
+    (the decision propagates across the process hop): never half-kept."""
+    from ray_tpu import serve
+    from ray_tpu.util import tracing
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, fail=False):
+            from ray_tpu.util import tracing as t
+
+            with t.span("replica_work", kind="serve_replica"):
+                if fail:
+                    raise ValueError("boom")
+                return 1
+
+    handle = serve.run(Echo.bind(), name="sampled_echo")
+    try:
+        assert handle.remote(False).result(timeout=120) == 1
+        tracing.flush_spans()
+        time.sleep(1.5)   # > the worker event-flush period
+        assert _serve_spans() == [], _serve_spans()
+    finally:
+        serve.shutdown()
+
+
+def test_errored_serve_round_trip_keeps_all_spans(
+        ray_cluster, sampled_out):
+    """Sampling never hides failures: an errored round trip emits ALL
+    its spans (handle root with status=error via the deferred-outcome
+    emission, replica-side span with status=error) even at rate 0."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo2:
+        def __call__(self, fail=False):
+            from ray_tpu.util import tracing as t
+
+            with t.span("replica_work", kind="serve_replica"):
+                if fail:
+                    raise ValueError("boom")
+                return 1
+
+    handle = serve.run(Echo2.bind(), name="sampled_echo2")
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            handle.remote(True).result(timeout=120)
+        from ray_tpu.util import tracing
+
+        tracing.flush_spans()
+        deadline = time.time() + 20
+        spans = []
+        while time.time() < deadline:
+            spans = _serve_spans()
+            if {"serve_handle", "serve_replica"} <= \
+                    {s["kind"] for s in spans}:
+                break
+            time.sleep(0.2)
+        kinds = {s["kind"]: s for s in spans}
+        assert "serve_handle" in kinds and "serve_replica" in kinds, spans
+        assert kinds["serve_handle"]["status"] == "error"
+        assert kinds["serve_replica"]["status"] == "error"
+        # Same trace: the decision and identity propagated as one.
+        assert kinds["serve_handle"]["trace_id"] == \
+            kinds["serve_replica"]["trace_id"]
+    finally:
+        serve.shutdown()
+
+
+def test_sampled_in_serve_round_trip_keeps_spans(ray_cluster):
+    """Rate 1.0 (default): the ok round trip emits its spans — the
+    sampled-out test above is measuring the knob, not a regression."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo3:
+        def __call__(self):
+            return 1
+
+    handle = serve.run(Echo3.bind(), name="sampled_echo3")
+    try:
+        assert handle.remote().result(timeout=120) == 1
+        from ray_tpu.util import tracing
+
+        tracing.flush_spans()
+        deadline = time.time() + 20
+        hops = []
+        while time.time() < deadline:
+            hops = [e for e in ray_tpu.timeline()
+                    if e.get("kind") == "serve_handle"
+                    and "sampled_echo3" in e["name"]]
+            if hops:
+                break
+            time.sleep(0.2)
+        assert hops and hops[0]["status"] == "ok", hops
+    finally:
+        serve.shutdown()
