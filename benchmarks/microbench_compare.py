@@ -19,6 +19,12 @@ Toggles:
                     every process vs off (the ISSUE 12 overhead bound:
                     tasks_sync/tasks_async must stay >=0.95x with the
                     sampler on)
+  submit_template   RAY_TPU_SUBMIT_SPEC_TEMPLATE_ENABLED — patch-the-
+                    bytes spec templates vs per-call TaskSpec
+                    construction + pickle (SCALE_r08 stage 1)
+  submit_ring       RAY_TPU_SUBMIT_RING_ENABLED — shm submit ring to
+                    the same-node NM vs the socket batch path
+                    (SCALE_r08 stage 3)
 
 Run:  python benchmarks/microbench_compare.py [rounds] [out.json] [toggle]
 """
@@ -51,6 +57,16 @@ TOGGLES = {
                  "overhead A/B behind the 'always-available flamegraphs' "
                  "claim; on/off >=0.95x on tasks_sync/tasks_async is "
                  "the acceptance bound"),
+    "submit_template": ("RAY_TPU_SUBMIT_SPEC_TEMPLATE_ENABLED",
+                        "pre-serialized TaskSpec templates — each "
+                        "submission patches task id / args / timestamp "
+                        "into a frozen pickled skeleton — vs per-call "
+                        "TaskSpec construction + pickle.dumps"),
+    "submit_ring": ("RAY_TPU_SUBMIT_RING_ENABLED",
+                    "shared-memory submit ring to the same-node node "
+                    "manager (classic-path dep-free submissions become "
+                    "a memcpy + doorbell; the NM relays blobs to the "
+                    "GCS) vs the socket submit_task_batch path"),
 }
 
 

@@ -23,7 +23,18 @@ Measures, on one GCS process:
 
 Prints one JSON line per metric. Run: python benchmarks/scale_bench.py
 [N_tasks] [K_actors] [--gcs-out-of-process {0,1}]
-[--profile-submit OUT.speedscope.json].
+[--profile-submit OUT.speedscope.json] [--drivers N]
+[--submit-fastpath {0,1}].
+
+``--drivers N`` sizes the multi-driver phase (default 3) so the
+SCALE_r08 3-driver aggregate — and any other width — reproduces from
+one command.
+
+``--submit-fastpath`` pins ALL THREE driver submit-pipeline stages
+(RAY_TPU_SUBMIT_SPEC_TEMPLATE_ENABLED / _SUBMIT_BATCH_FRAMES_ENABLED /
+_SUBMIT_RING_ENABLED) for this run and every child driver: the
+SCALE_r08 A/B is two runs of this script, 1 vs 0, same box, per
+microbench_compare conventions.
 
 ``--profile-submit`` runs the in-process sampling profiler
 (ray_tpu._private.profiler) over the DRIVER for exactly the infeasible-
@@ -124,6 +135,8 @@ def main():
     args = []
     gcs_oop = None
     profile_out = None
+    submit_fastpath = None
+    n_drivers = 3
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -136,6 +149,20 @@ def main():
                 v = argv[i]
             gcs_oop = v.strip().lower() not in ("0", "false", "off") \
                 if v else True
+        elif a.startswith("--submit-fastpath"):
+            _, eq, v = a.partition("=")
+            if not eq and i + 1 < len(argv) and argv[i + 1].lower() in (
+                    "0", "1", "true", "false", "on", "off"):
+                i += 1
+                v = argv[i]
+            submit_fastpath = v.strip().lower() not in (
+                "0", "false", "off") if v else True
+        elif a.startswith("--drivers"):
+            _, eq, v = a.partition("=")
+            if not eq and i + 1 < len(argv):
+                i += 1
+                v = argv[i]
+            n_drivers = max(1, int(v))
         elif a.startswith("--profile-submit"):
             _, eq, v = a.partition("=")
             if not eq and i + 1 < len(argv):
@@ -147,6 +174,12 @@ def main():
         i += 1
     n_tasks = int(args[0]) if len(args) > 0 else 100_000
     k_actors = int(args[1]) if len(args) > 1 else 200
+
+    _SUBMIT_KNOBS = ("SUBMIT_SPEC_TEMPLATE_ENABLED",
+                     "SUBMIT_BATCH_FRAMES_ENABLED", "SUBMIT_RING_ENABLED")
+    if submit_fastpath is not None:
+        for k in _SUBMIT_KNOBS:
+            os.environ["RAY_TPU_" + k] = "1" if submit_fastpath else "0"
 
     import ray_tpu
     from ray_tpu._private.config import config as _cfg
@@ -164,6 +197,13 @@ def main():
         else "in_process",
         "toggle": "--gcs-out-of-process / RAY_TPU_GCS_OUT_OF_PROCESS"}),
         flush=True)
+    print(json.dumps({
+        "metric": "submit_fastpath",
+        "value": {"template": bool(_cfg.submit_spec_template_enabled),
+                  "batch_frames": bool(_cfg.submit_batch_frames_enabled),
+                  "ring": bool(_cfg.submit_ring_enabled)},
+        "toggle": "--submit-fastpath / RAY_TPU_SUBMIT_{SPEC_TEMPLATE,"
+                  "BATCH_FRAMES,RING}_ENABLED"}), flush=True)
     from ray_tpu._private import worker as worker_mod
     try:
         @ray_tpu.remote(resources={"impossible": 1})
@@ -252,8 +292,7 @@ def main():
         w = worker_mod.global_worker()
         deadline = time.time() + 60
         while time.time() < deadline:
-            with w._refs._lock:
-                left = len(w._refs._pending)
+            left = len(w._refs._inc_log) + len(w._refs._dec_log)
             if left == 0:
                 break
             time.sleep(0.1)
@@ -280,7 +319,7 @@ def main():
         # regime; SCALE_r04 only ever measured one driver). Reports
         # aggregate throughput and the worst per-driver p95.
         address = worker_mod.global_worker().gcs_address
-        n_drivers, per_driver = 3, 600
+        per_driver = 600
         child_src = f"""
 import json, sys, time
 sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})

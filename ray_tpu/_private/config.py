@@ -228,6 +228,51 @@ _d("local_actor_creation_enabled", True,
    "GCS-scheduled creation path. Off = every actor creation serializes "
    "through the central scheduler.")
 
+# --- driver submit fast path (spec templates / batch frames / shm ring) -----
+_d("submit_spec_template_enabled", True,
+   "Pre-serialized task-spec templates: a RemoteFunction freezes its "
+   "constant TaskSpec fields (function key, resources, options, caller "
+   "identity) into a pickled skeleton once, and each submission patches "
+   "only the variable slots (task id, args blob, submit time) into a "
+   "copy of the bytes — per-call TaskSpec.__init__ and the full "
+   "pickle.dumps leave the submit hot path. Calls the template cannot "
+   "represent (arg deps, traced submissions, spilled arg blobs) fall "
+   "back to classic construction. Off = every submission builds and "
+   "pickles its spec from scratch (the pre-SCALE_r08 baseline; the "
+   "'submit_template' toggle in benchmarks/microbench_compare.py).")
+_d("submit_template_verify", False,
+   "Template correctness mode: every template-patched spec blob is "
+   "compared against a fresh pickle.dumps of an equivalently "
+   "constructed TaskSpec and must match BYTE-FOR-BYTE (raises on "
+   "mismatch). The equivalence test suite turns this on; leave it off "
+   "in production — it re-pays exactly the per-call cost the template "
+   "exists to remove.")
+_d("submit_batch_frames_enabled", True,
+   "Multi-spec submit framing end-to-end: driver->GCS classic-path "
+   "submissions coalesce into submit_task_batch frames of pre-pickled "
+   "spec blobs (flushed at batch size, on get()/wait() entry, and by "
+   "the lease flush loop), and lease-path dispatch ships "
+   "lease_run_tasks_b blob batches instead of re-pickling every spec "
+   "inside the frame envelope. Specs with arg deps keep the classic "
+   "single-spec frame on the driver's own GCS conn (same-conn FIFO "
+   "with the refcount flush is what makes their pin-before-decref "
+   "ordering hold). Off = one frame per spec (pre-SCALE_r08).")
+_d("submit_ring_enabled", True,
+   "Shared-memory submit ring to the same-node node manager: classic-"
+   "path, dep-free submissions become a template-patched blob appended "
+   "to a per-client SPSC ring in a mmapped session file the NM drains "
+   "and relays to the GCS in batches — no socket write, no frame "
+   "pickling on the driver. Futex-style doorbell: the producer only "
+   "touches the doorbell socket when the consumer has parked itself. "
+   "Ring-full and NM-death fall back cleanly to the socket batch path "
+   "(driver_submit_ring_full_total counts the former; unconsumed "
+   "records are recovered and resubmitted on the latter). The "
+   "'submit_ring' toggle in benchmarks/microbench_compare.py.")
+_d("submit_ring_bytes", 4 * 1024 * 1024,
+   "Data capacity of the per-client submit ring. At ~200 bytes per "
+   "nop-task spec blob the default holds ~20k in-flight submissions "
+   "before ring-full spills to the socket path.")
+
 # --- direct task transport (worker leases) ---------------------------------
 _d("lease_enabled", True,
    "Stream same-shape tasks directly to leased workers, bypassing the "

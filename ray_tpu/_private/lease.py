@@ -31,11 +31,13 @@ from __future__ import annotations
 
 import collections
 import concurrent.futures
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import protocol
+from ray_tpu._private.spec_template import invalidate_wire, spec_wire
 
 TPU = "TPU"
 
@@ -43,6 +45,40 @@ TPU = "TPU"
 # manager without touching the GCS; "gcs" = the central spillback path).
 _grant_latency = None
 _grant_latency_lock = threading.Lock()
+
+# Driver submit pipeline metrics (batched framing + shm ring): created
+# lazily like the grant-latency histogram so importing this module never
+# spins a reporter.
+_submit_metrics = None
+_submit_metrics_lock = threading.Lock()
+
+
+def _submit_metrics_get():
+    global _submit_metrics
+    if _submit_metrics is None:
+        with _submit_metrics_lock:
+            if _submit_metrics is None:
+                from ray_tpu.util import metrics
+
+                _submit_metrics = (
+                    metrics.Counter(
+                        "driver_submit_batches_total",
+                        "Multi-spec submit frames shipped by the driver "
+                        "(tag path: gcs=classic submit_task_batch, "
+                        "lease=lease_run_tasks_b)",
+                        tag_keys=("path",)),
+                    metrics.Histogram(
+                        "driver_submit_batch_size",
+                        "Specs per driver submit batch frame",
+                        boundaries=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512],
+                        tag_keys=("path",)),
+                    metrics.Counter(
+                        "driver_submit_ring_full_total",
+                        "Submissions that found the shm submit ring full "
+                        "and fell back to the socket batch path"),
+                )
+                metrics.start_reporter()
+    return _submit_metrics
 
 
 def _grant_latency_hist():
@@ -128,6 +164,32 @@ class LeaseManager:
         self._worker_timeout = float(config.worker_start_timeout_s) + 10.0
         self._bulk_conn = None   # lazy second GCS conn for fallback waves
         self._closed = False
+        # Batched submit framing (SCALE_r08 stage 2): classic-path
+        # dep-free specs coalesce here as PRE-PICKLED blobs and ship as
+        # one submit_task_batch frame per _CLASSIC_BATCH (or on
+        # get()/wait() entry / the flush loop); the lease dispatch path
+        # ships lease_run_tasks_b blob batches the same way.
+        self._batch_frames = bool(config.submit_batch_frames_enabled)
+        self._classic_buf: List[bytes] = []
+        # Deferred blob-route submissions: the caller thread appends
+        # (template, tid, args, t) tuples — the absolute minimum — and
+        # the lease executor patches + ships them (submit_classic_patch;
+        # queueing beats sending on the caller's critical path).
+        self._defer_buf: List[tuple] = []
+        self._classic_lock = threading.Lock()
+        # Shm submit ring (stage 3): registered lazily with our node
+        # manager on first classic submission; 0=never tried,
+        # 1=registering, 2=active, 3=dead/unavailable.
+        self._ring = None
+        self._ring_state = 0
+        # x86-64 only: the ring's payload-before-tail publication relies
+        # on TSO store-store ordering, which pure-Python mmap writes
+        # cannot fence on weaker memory models (arm64).
+        import platform
+
+        self._ring_enabled = (self._batch_frames
+                              and bool(config.submit_ring_enabled)
+                              and platform.machine() in ("x86_64", "AMD64"))
         # In-flight local lease requests awaiting the NM's deferred reply
         # (deadline-bounded by _check_local_waits on the flush loop).
         self._local_waits: List[dict] = []
@@ -188,6 +250,17 @@ class LeaseManager:
                 # don't let the identity memo grow with it.
                 self._shape_keys.clear()
             self._shape_keys[id(res)] = (res, key)
+        # Lock-free fast decline for a shape inside its denial window
+        # (the sustained-flood hot path: every submission would otherwise
+        # pay the manager lock just to learn "go classic"). All reads are
+        # GIL-atomic snapshots; any staleness only sends this spec down
+        # the ALWAYS-correct classic path or falls through to the locked
+        # check below.
+        st0 = self._shapes.get(key)
+        if st0 is not None and not st0.leases and st0.requesting == 0 \
+                and not st0.queue \
+                and time.monotonic() < st0.denied_until:
+            return False
         with self._lock:
             if self._closed:
                 return False
@@ -230,11 +303,13 @@ class LeaseManager:
         return True
 
     _SEND_BATCH = 16
+    _CLASSIC_BATCH = 256
 
     def flush_sends(self) -> None:
         """Ship every coalesced submit batch now. Called on get()/wait()
         entry (a caller about to block must not sit on its own work),
         from completions, and by the flush loop."""
+        self._flush_classic()
         with self._lock:
             if not self._sendbuf:
                 return
@@ -244,11 +319,226 @@ class LeaseManager:
             if specs and not lease.dead:
                 self._send(lease, specs)
 
+    # ---------------------------------------------- classic-path batching
+
+    def classic_route(self, resources: Dict[str, float]) -> bool:
+        """Lock-free: True when a submission of this shape cannot ride a
+        lease RIGHT NOW — no lease exists and the shape is either inside
+        its denial backoff or still waiting on a grant. Lets the caller
+        skip spec-object construction entirely and ship template-patched
+        bytes (submit_classic_patch). Sustained infeasible/over-capacity
+        floods then stream down the blob route instead of convoying
+        through queue-and-drain cycles; the few specs a feasible shape
+        submits between its first queue-and-request and the grant
+        landing take the scheduled path — always correct, just not
+        direct. All reads are GIL-atomic snapshots; staleness only costs
+        one spec the slower trip."""
+        if not self._batch_frames or self._closed:
+            return False
+        ent = self._shape_keys.get(id(resources))
+        if ent is None or ent[0] is not resources:
+            return False   # first sighting: take the full submit path
+        st = self._shapes.get(ent[1])
+        return (st is not None and not st.leases
+                and (st.requesting > 0
+                     or time.monotonic() < st.denied_until))
+
+    def submit_classic(self, spec) -> bool:
+        """Take ownership of a spec bound for the GCS-scheduled path:
+        ship it through the shm submit ring when available, else
+        coalesce its pre-pickled blob into a submit_task_batch frame.
+        Returns False (caller must notify the GCS itself, single-spec
+        frame on its own conn) for dep-carrying specs — their pin-
+        before-decref ordering relies on same-conn FIFO with the
+        refcount flush — and when batch framing is off."""
+        if not self._batch_frames or self._closed or spec.arg_deps:
+            return False
+        return self.submit_classic_blob(spec_wire(spec))
+
+    def submit_classic_blob(self, wire: bytes) -> bool:
+        """Ship one pre-pickled, DEP-FREE spec blob down the classic
+        batch path (ring when available, coalesced socket frame
+        otherwise). The blob-only route: callers that already know the
+        lease path declines (classic_route) never build a spec object."""
+        if not self._batch_frames or self._closed:
+            return False
+        if self._ring_enabled:
+            ring = self._ring
+            if ring is None:
+                self._maybe_register_ring(inline=False)
+            elif ring.active and not ring.dead:
+                if ring.append(wire):
+                    return True
+                try:
+                    _submit_metrics_get()[2].inc()
+                except Exception:
+                    pass
+        batch = None
+        with self._classic_lock:
+            self._classic_buf.append(wire)
+            if len(self._classic_buf) >= self._CLASSIC_BATCH:
+                batch = self._classic_buf
+                self._classic_buf = []
+        if batch:
+            self._classic_send(batch)
+        return True
+
+    _DEFER_BATCH = 512
+
+    def submit_classic_patch(self, tpl, tid_bytes: bytes, args: bytes,
+                             submitted_at: float) -> bool:
+        """The blob-only route's caller-side half: append the variable
+        slots and return — template patching, ring writes, and frame
+        sends all happen on the lease executor. One uncontended lock
+        acquisition + a list append on the submit hot path."""
+        if not self._batch_frames or self._closed:
+            return False
+        batch = None
+        with self._classic_lock:
+            buf = self._defer_buf
+            buf.append((tpl, tid_bytes, args, submitted_at))
+            if len(buf) >= self._DEFER_BATCH:
+                batch, self._defer_buf = buf, []
+        if batch:
+            self._exec_submit(self._drain_deferred, batch)
+        return True
+
+    def _maybe_register_ring(self, inline: bool) -> None:
+        """One-shot CAS into the registering state (0 -> 1); never after
+        close() — a shutdown-time flush must not dial the NM or create a
+        ring file it would immediately tear down."""
+        if not self._ring_enabled or self._closed \
+                or self._ring_state != 0:
+            return
+        register = False
+        with self._classic_lock:
+            if self._ring_state == 0:
+                self._ring_state = 1
+                register = True
+        if not register:
+            return
+        if inline:
+            self._register_ring()   # caller is already off the hot path
+        else:
+            self._exec_submit(self._register_ring)
+
+    def _drain_deferred(self, batch: List[tuple]):
+        """Patch + ship a deferred blob-route batch (lease executor /
+        flush paths)."""
+        if self._ring_enabled and self._ring is None:
+            self._maybe_register_ring(inline=True)
+        ring = self._ring
+        use_ring = (ring is not None and ring.active and not ring.dead)
+        out = []
+        for tpl, tid_bytes, args, t in batch:
+            blob = tpl.patch(tid_bytes, args, t)
+            if use_ring:
+                if ring.append(blob):
+                    continue
+                use_ring = False
+                try:
+                    _submit_metrics_get()[2].inc()
+                except Exception:
+                    pass
+            out.append(blob)
+        for i in range(0, len(out), self._CLASSIC_BATCH):
+            self._classic_send(out[i:i + self._CLASSIC_BATCH])
+
+    def _flush_classic(self):
+        with self._classic_lock:
+            deferred, self._defer_buf = self._defer_buf, []
+            batch, self._classic_buf = self._classic_buf, []
+        if deferred:
+            self._drain_deferred(deferred)
+        if batch:
+            self._classic_send(batch)
+
+    def _classic_send(self, blobs: List[bytes]):
+        """One submit_task_batch frame on the bulk conn (the GCS serves
+        each conn on its own thread, so the driver's synchronous RPCs on
+        the main channel never queue behind a wave)."""
+        try:
+            self._bulk_conn_get().notify("submit_task_batch", blobs)
+        except Exception:
+            try:
+                self._w.gcs.notify("submit_task_batch", blobs)
+            except Exception:
+                return   # driver is dying; its refs error out with it
+        try:
+            m = _submit_metrics_get()
+            m[0].inc(tags={"path": "gcs"})
+            m[1].observe(len(blobs), tags={"path": "gcs"})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- submit ring
+
+    def _register_ring(self):
+        """Create + register the shm submit ring with our node manager
+        (runs on the lease executor — never on the submit hot path)."""
+        from ray_tpu._private.config import config
+        from ray_tpu._private import submit_ring
+
+        addr = self._local_nm_addr
+        if addr is None or not self._ring_enabled or self._closed:
+            self._ring_state = 3
+            return
+        writer = None
+        try:
+            path = os.path.join(
+                os.path.dirname(self._w.store_path),
+                f"subring_{os.getpid()}_{id(self) & 0xffffff:x}")
+            writer = submit_ring.RingWriter(
+                path, int(config.submit_ring_bytes))
+            ok = self._w.nm_conn(addr).request(
+                "register_submit_ring",
+                {"client_id": self._w.client_id, "path": path},
+                timeout=min(30.0, float(config.gcs_rpc_timeout_s)))
+            if not ok:
+                raise RuntimeError("node manager declined submit ring")
+            writer.connect_bell()
+            writer.active = True
+            self._ring = writer
+            self._ring_state = 2
+        except Exception:
+            self._ring_state = 3
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+    # Comfortably above the NM relay's 2s per-attempt GCS timeout plus
+    # its retry sleep: the drain thread re-beats between attempts, so a
+    # healthy-but-GCS-stalled ring can never look dead.
+    _RING_STALE_S = 5.0
+
+    def _check_ring(self):
+        """NM-death fallback (runs on the flush loop): a stale consumer
+        heartbeat with records pending means the NM (or its drain
+        thread) died — recover every unconsumed record and resubmit it
+        over the socket batch path. At-least-once end to end: the GCS
+        batch handler dedups on task id."""
+        ring = self._ring
+        if ring is None or not ring.active:
+            return
+        if ring.consumer_stale(self._RING_STALE_S):
+            blobs = ring.recover_unconsumed()
+            self._ring = None
+            self._ring_state = 3
+            try:
+                ring.close()
+            except Exception:
+                pass
+            for i in range(0, len(blobs), self._CLASSIC_BATCH):
+                self._classic_send(blobs[i:i + self._CLASSIC_BATCH])
+
     def _incref_deps(self, spec):
         refs = self._w._refs
-        if refs is not None:
-            for d in spec.arg_deps:
-                refs.incref(d.binary())
+        if refs is not None and spec.arg_deps:
+            # One refcount-lock acquisition per submission, not one per
+            # dep (the r08 profile's incref tower).
+            refs.incref_many([d.binary() for d in spec.arg_deps])
 
     def _pick_lease_locked(self, st: _ShapeState) -> Optional[_Lease]:
         best = None
@@ -271,9 +561,22 @@ class LeaseManager:
     def _send(self, lease: _Lease, specs: List[Any]):
         """Ship a batch of (already reserved) specs to the leased worker.
         One notify per batch; results come back batched too. Arg deps were
-        pinned at submit()."""
+        pinned at submit(). With batch framing on, the frame carries
+        PRE-PICKLED spec blobs (template-patched when available) so the
+        envelope pickle is a memcpy of bytes, not a re-serialization of
+        every spec."""
         try:
-            lease.conn.notify("lease_run_tasks", specs)
+            if self._batch_frames:
+                lease.conn.notify("lease_run_tasks_b",
+                                  [spec_wire(s) for s in specs])
+                try:
+                    m = _submit_metrics_get()
+                    m[0].inc(tags={"path": "lease"})
+                    m[1].observe(len(specs), tags={"path": "lease"})
+                except Exception:
+                    pass
+            else:
+                lease.conn.notify("lease_run_tasks", specs)
         except BaseException:
             self._fail_specs(lease, specs)
 
@@ -551,18 +854,24 @@ class LeaseManager:
     def _fallback_many(self, specs: List[Any]):
         """Wave fallback (capacity denial, lease drop): batched submits
         so a big queued burst costs the GCS one handler invocation per
-        chunk, not per spec."""
+        chunk, not per spec. With batch framing on, the chunk ships as
+        pre-pickled blobs (reusing each spec's template-patched bytes
+        instead of re-serializing the wave)."""
         for i in range(0, len(specs), self._FALLBACK_CHUNK):
             chunk = specs[i:i + self._FALLBACK_CHUNK]
-            try:
-                self._bulk_conn_get().notify("submit_tasks", list(chunk))
-            except Exception:
-                # Bulk conn unavailable: the main (reconnecting) channel
-                # still delivers; a dying driver's refs error out anyway.
+            if self._batch_frames:
+                self._classic_send([spec_wire(s) for s in chunk])
+            else:
                 try:
-                    self._w.gcs.notify("submit_tasks", list(chunk))
+                    self._bulk_conn_get().notify("submit_tasks", list(chunk))
                 except Exception:
-                    pass
+                    # Bulk conn unavailable: the main (reconnecting)
+                    # channel still delivers; a dying driver's refs
+                    # error out with it anyway.
+                    try:
+                        self._w.gcs.notify("submit_tasks", list(chunk))
+                    except Exception:
+                        pass
             for s in chunk:
                 self._decref_deps(s)
 
@@ -653,9 +962,11 @@ class LeaseManager:
                 self._decref_deps(spec)
             else:
                 # Hand the GCS the REMAINING budget (its submit handler
-                # re-arms retries_left from max_retries).
+                # re-arms retries_left from max_retries). The cached
+                # wire blob predates the mutation — drop it.
                 spec.max_retries = left - 1
                 spec.retries_left = left - 1
+                invalidate_wire(spec)
                 self._fallback(spec)  # fallback releases the submit pin
         self._exec_submit(self._drop_lease, lease)
 
@@ -695,9 +1006,8 @@ class LeaseManager:
 
     def _decref_deps(self, spec):
         refs = self._w._refs
-        if refs is not None:
-            for d in spec.arg_deps:
-                refs.decref(d.binary())
+        if refs is not None and spec.arg_deps:
+            refs.decref_many([d.binary() for d in spec.arg_deps])
 
     # -------------------------------------------------------- lease drop
 
@@ -848,6 +1158,7 @@ class LeaseManager:
                 self._reap_idle()
                 self._retry_backlogged()
                 self._check_local_waits()
+                self._check_ring()
             except Exception:
                 pass
 
@@ -921,6 +1232,19 @@ class LeaseManager:
                 ent["ev"].set()
             self._inflight.clear()
         self._stop.set()
+        self._flush_classic()
+        ring = self._ring
+        if ring is not None:
+            self._ring = None
+            # Unconsumed records would die with the ring file — push
+            # them through the socket path before tearing it down.
+            blobs = ring.recover_unconsumed()
+            for i in range(0, len(blobs), self._CLASSIC_BATCH):
+                self._classic_send(blobs[i:i + self._CLASSIC_BATCH])
+            try:
+                ring.close()
+            except Exception:
+                pass
         self._flush_reports()
         for lease in leases:
             try:
@@ -949,6 +1273,9 @@ class LeaseManager:
         self._fallback_many(queued)
         if self._bulk_conn is not None:
             try:
+                # Let queued batch frames reach the socket before the
+                # shutdown aborts the writer (close() is immediate).
+                self._bulk_conn.flush(2.0)
                 self._bulk_conn.close()
             except Exception:
                 pass

@@ -48,6 +48,11 @@ CONTROL_PLANE = (
     # process someone is trying to diagnose.
     "ray_tpu/_private/profiler.py",
     "ray_tpu/_private/device_objects.py",
+    # The shm submit ring: its drain thread runs inside every node
+    # manager and its writer is called from arbitrary driver threads —
+    # a blocking call under its lock or an unbounded park here stalls
+    # the submit pipeline of a whole client.
+    "ray_tpu/_private/submit_ring.py",
     "ray_tpu/parallel/collective.py",
     "ray_tpu/train/worker_group.py",
     # The LLM serving tier: the engine's scheduler thread and the

@@ -262,6 +262,13 @@ class NodeManager:
         self._actor_exec = _cf.ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="rtpu-nm-actor")
 
+        # Shared-memory submit rings (SCALE_r08 stage 3): per-client SPSC
+        # rings of pre-pickled task-spec blobs this NM drains and relays
+        # to the GCS in submit_task_batch frames — the same-node driver
+        # submits with a memcpy + doorbell instead of a socket frame.
+        # conn -> [{reader, thread, stop}]; cleaned up on disconnect.
+        self._submit_rings: Dict[Any, List[dict]] = {}
+
         # Server for workers, remote pullers, and actor-task callers.
         self.server = protocol.Server(self._handle_server, name=f"nm-{node_name}")
         self.server.on_disconnect = self._on_server_disconnect
@@ -1043,6 +1050,9 @@ class NodeManager:
                           if w.leased_conn is conn]
                 dead_grants = [lid for lid, g in self._local_grants.items()
                                if g["conn"] is conn]
+                rings = self._submit_rings.pop(conn, [])
+            for ent in rings:
+                ent["stop"] = True   # drain thread exits after a final pass
             for lid in dead_grants:
                 self._release_local_grant(lid)
             for w in leased:
@@ -1900,6 +1910,8 @@ class NodeManager:
                     self._request_create_actor_safe, conn, payload, msg_id)
             elif mtype == protocol.RETURN_LOCAL_LEASE:
                 self._on_return_local_lease(conn, payload)
+            elif mtype == "register_submit_ring":
+                self._on_register_submit_ring(conn, payload, msg_id)
             elif mtype == protocol.SCHEDULER_STATS:
                 conn.reply(msg_id, self._scheduler_stats())
             elif mtype == "abandon_lease":
@@ -2370,6 +2382,94 @@ class NodeManager:
                     w2.lease_grant = None
         if w is not None:
             self._release_leased_worker(w)
+
+    # ------------------------------------------------- shm submit rings
+
+    _RING_RELAY_CHUNK = 256
+
+    def _on_register_submit_ring(self, conn, p, msg_id):
+        """A same-node driver published a submit ring file: map it,
+        own its doorbell, and start one drain thread that relays record
+        blobs to the GCS as submit_task_batch frames (no unpickle here —
+        the relay is a byte pump)."""
+        from ray_tpu._private import submit_ring
+
+        if self._shutdown:
+            conn.reply(msg_id, False)
+            return
+        try:
+            reader = submit_ring.RingReader(p["path"])
+        except Exception as e:
+            logger.warning("submit ring %s rejected: %s", p.get("path"), e)
+            conn.reply(msg_id, False)
+            return
+        ent = {"reader": reader, "stop": False,
+               "client_id": p.get("client_id")}
+        t = threading.Thread(target=self._submit_ring_loop, args=(ent,),
+                             daemon=True, name="rtpu-nm-subring")
+        ent["thread"] = t
+        with self._lock:
+            self._submit_rings.setdefault(conn, []).append(ent)
+        t.start()
+        conn.reply(msg_id, True)
+
+    def _submit_ring_loop(self, ent: dict):
+        """Drain thread: beat the liveness heartbeat, relay pending
+        records, park on the doorbell when idle. The consumer head
+        advances only AFTER the GCS relay call returns (at-least-once;
+        the GCS batch handler dedups on task id)."""
+        reader = ent["reader"]
+        pending = None   # (blobs, new_head, seq): one batch pinned
+        try:
+            while not ent["stop"] and not self._shutdown:
+                reader.beat()
+                if pending is not None:
+                    blobs, new_head, seq = pending
+                else:
+                    blobs, new_head = reader.drain(self._RING_RELAY_CHUNK)
+                    seq = None
+                if blobs:
+                    if seq is None:
+                        ent["seq"] = seq = ent.get("seq", 0) + 1
+                        # Pin the batch: a retry must resend EXACTLY
+                        # these records under this seq — a regrown
+                        # drain under a reused seq would get its new
+                        # records dropped by the GCS's seq dedup.
+                        pending = (blobs, new_head, seq)
+                    try:
+                        # Request, not notify: a fire-and-forget frame
+                        # only ENQUEUES on the NM->GCS conn, and
+                        # committing on that would lose queued-but-
+                        # unflushed records if this NM dies. The GCS
+                        # handler ACKs after the batch is enqueued. The
+                        # timeout is SHORT so this thread's liveness
+                        # beat never starves past the driver's ring
+                        # staleness budget (lease._RING_STALE_S); a
+                        # timed-out-but-landed batch is retried with the
+                        # SAME (src, seq), which the GCS drops exactly.
+                        self.gcs.request(
+                            "submit_task_batch",
+                            {"blobs": blobs, "src": reader.path,
+                             "seq": seq},
+                            timeout=2.0)
+                    except Exception:
+                        # GCS conn mid-redial / timed out: keep the
+                        # pinned batch (head not committed), re-beat,
+                        # and retry the same (src, seq).
+                        reader.beat()
+                        time.sleep(0.2)
+                        continue
+                    pending = None
+                    reader.commit(new_head)
+                    continue
+                if reader.producer_closed():
+                    break
+                reader.park_wait()
+        finally:
+            try:
+                reader.close()
+            except Exception:
+                pass
 
     def _on_revoke_local_lease(self, p):
         """GCS fairness signal: classic-queue work competing with

@@ -212,6 +212,12 @@ class Conn:
         finally:
             self._release_fd()
 
+    # One gathered write flushes up to this many queued frames / bytes
+    # per syscall (bounded by IOV_MAX=1024 and by how much we want a
+    # single sendmsg to pin the write lock).
+    GATHER_MAX_FRAMES = 64
+    GATHER_MAX_BYTES = 4 * 1024 * 1024
+
     def _write_loop_inner(self):
         while True:
             # raylint: disable-next=unbounded-wait (dedicated writer
@@ -220,32 +226,62 @@ class Conn:
             while True:
                 if not self._send_q:
                     break
-                # q[0] is read AND sent under the write lock: an inline
-                # fast-path sender (_send) that just pushed a partial
-                # frame's remainder to the front must see it go out
-                # before anything else, and frames must never interleave.
+                # The queue head is read AND sent under the write lock:
+                # an inline fast-path sender (_send) that just pushed a
+                # partial frame's remainder to the front must see it go
+                # out before anything else, and frames must never
+                # interleave. A run of ready frames drains in ONE
+                # gathered sendmsg instead of one send per frame — a
+                # submit burst costs one writer wakeup + one syscall.
                 # raylint: disable-next=blocking-under-lock (the write
                 # lock serializes frame bytes on the wire; the inline
                 # fast path only ever tries acquire(False), so no
-                # handler thread can block behind this sendall)
+                # handler thread can block behind this send)
                 with self._write_lock:
                     if not self._send_q:
                         break
-                    frame = self._send_q[0]  # pop only after the send
-                    self._send_inflight = True  # completes, so flush()
-                    try:                        # can't miss it
-                        self._sock.sendall(frame)
+                    # Indexed reads, NOT iteration: producers append to
+                    # the deque without the write lock, and iterating a
+                    # deque while another thread appends raises
+                    # RuntimeError (which would kill this writer). Only
+                    # this thread pops, so indices [0, n) stay valid.
+                    bufs = []
+                    total = 0
+                    n = min(len(self._send_q), self.GATHER_MAX_FRAMES)
+                    for i in range(n):
+                        f = self._send_q[i]
+                        bufs.append(f)
+                        total += len(f)
+                        if total >= self.GATHER_MAX_BYTES:
+                            break
+                    self._send_inflight = True  # flush() can't miss it
+                    try:
+                        if len(bufs) == 1:
+                            self._sock.sendall(bufs[0])
+                            sent = len(bufs[0])
+                        else:
+                            sent = self._sock.sendmsg(bufs)
                     except (BrokenPipeError, ConnectionResetError, OSError):
                         self._send_inflight = False
                         self.close()
                         return
+                    # Pop fully-sent frames; a partially-sent frame's
+                    # remainder replaces it at the queue head (still
+                    # under the write lock, so nothing interleaves).
+                    freed = 0
+                    left = sent
+                    for f in bufs:
+                        if left >= len(f):
+                            left -= len(f)
+                            freed += len(self._send_q.popleft())
+                        else:
+                            if left:
+                                self._send_q[0] = f[left:]
+                                freed += left
+                            break
                     self._send_inflight = False
-                try:
-                    self._send_q.popleft()
-                except IndexError:
-                    pass
                 with self._send_cv:
-                    self._send_bytes = max(0, self._send_bytes - len(frame))
+                    self._send_bytes = max(0, self._send_bytes - freed)
                     self._send_cv.notify_all()
             self._send_ev.clear()
             if self._send_q:
@@ -254,8 +290,12 @@ class Conn:
                 return
 
     def notify(self, mtype: str, payload: Any = None) -> None:
-        """Fire-and-forget message."""
-        self._send(self._alloc_id(), None, mtype, payload)
+        """Fire-and-forget message. Notifies never get replies, so no
+        msg id is allocated (ids exist only to match replies to pending
+        request futures) — the per-message _next_id_lock round trip
+        stays off the hot path. 0 is never a pending-slot key (ids
+        start at 1), so a peer's stray reply-to-0 resolves nothing."""
+        self._send(0, None, mtype, payload)
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Best-effort wait until queued sends hit the socket (call before
@@ -307,10 +347,12 @@ class Conn:
                 self._pending.pop(fut.msg_id, None)
 
     def reply(self, to_msg_id: int, payload: Any = None) -> None:
-        self._send(self._alloc_id(), to_msg_id, "reply", payload)
+        # Replies are matched by reply_to alone; their own msg id is
+        # never read — skip the id allocation (see notify).
+        self._send(0, to_msg_id, "reply", payload)
 
     def reply_error(self, to_msg_id: int, err: str) -> None:
-        self._send(self._alloc_id(), to_msg_id, "reply", err, is_error=True)
+        self._send(0, to_msg_id, "reply", err, is_error=True)
 
     # -- receiving ------------------------------------------------------------
 

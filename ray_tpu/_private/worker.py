@@ -16,6 +16,7 @@ fetch RPC).
 from __future__ import annotations
 
 import atexit
+import collections
 import concurrent.futures
 import queue
 import hashlib
@@ -269,8 +270,16 @@ class _RefTracker:
         from ray_tpu._private.config import config
 
         self._worker = worker
-        self._pending: Dict[bytes, int] = {}
-        self._lock = threading.Lock()
+        # Lock-free delta logs (the r08 profile's incref tower was the
+        # per-ref lock round trip): producers append bare oids to a
+        # deque — a single GIL-atomic op — and the flusher consolidates.
+        # Decrefs are drained FIRST each flush: for any incref(t1) <
+        # decref(t2) pair, catching the decref implies the (earlier)
+        # incref is caught in the same pass, so a flush can never ship a
+        # ref's -1 ahead of its +1.
+        self._inc_log: collections.deque = collections.deque()
+        self._dec_log: collections.deque = collections.deque()
+        self._lock = threading.Lock()   # serializes flush consumers only
         self._stop = threading.Event()
         self._interval = max(0.01, config.refcount_flush_ms / 1000.0)
         self._thread = threading.Thread(
@@ -278,12 +287,16 @@ class _RefTracker:
         self._thread.start()
 
     def incref(self, oid: bytes):
-        with self._lock:
-            self._pending[oid] = self._pending.get(oid, 0) + 1
+        self._inc_log.append(oid)
 
     def decref(self, oid: bytes):
-        with self._lock:
-            self._pending[oid] = self._pending.get(oid, 0) - 1
+        self._dec_log.append(oid)
+
+    def incref_many(self, oids):
+        self._inc_log.extend(oids)
+
+    def decref_many(self, oids):
+        self._dec_log.extend(oids)
 
     def _loop(self):
         while not self._stop.wait(self._interval):
@@ -297,8 +310,23 @@ class _RefTracker:
 
     def flush(self):
         with self._lock:
-            deltas = dict(self._pending)
-            self._pending.clear()
+            deltas: Dict[bytes, int] = {}
+            dec, inc = self._dec_log, self._inc_log
+            # Decrefs first — see __init__ for the ordering argument.
+            # Bounded by the logs' CURRENT lengths so concurrent
+            # producers can't spin this consumer forever.
+            for _ in range(len(dec)):
+                try:
+                    oid = dec.popleft()
+                except IndexError:
+                    break
+                deltas[oid] = deltas.get(oid, 0) - 1
+            for _ in range(len(inc)):
+                try:
+                    oid = inc.popleft()
+                except IndexError:
+                    break
+                deltas[oid] = deltas.get(oid, 0) + 1
         # Net-zero deltas are still sent: they tell the GCS this object was
         # referenced at all (creating its count entry), so a ref born and
         # dropped within one flush window still becomes free-eligible.
@@ -405,6 +433,35 @@ class _GcsChannel:
         self._conn.close()
 
 
+class SubmitTemplate:
+    """Per-RemoteFunction holder for a pre-serialized TaskSpec skeleton
+    (see _private/spec_template.py), cached per CoreWorker generation —
+    a re-init() changes job/client identity, invalidating the frozen
+    constants."""
+
+    __slots__ = ("tpl", "core", "uses")
+
+    # Build only after a few eligible submissions: a template costs ~10
+    # pickles to build+self-check, so a one-shot .options() clone must
+    # not pay more than classic construction would have.
+    WARMUP_CALLS = 3
+
+    def __init__(self):
+        # core set with tpl None == build failed for this core: the
+        # submit path then stops trying (classic construction).
+        self.tpl = None
+        self.core = None
+        self.uses = 0
+
+    def __reduce__(self):
+        # A RemoteFunction closure-captured into a task pickles its
+        # holder along: ship a FRESH one. The frozen constants (caller
+        # identity, job) are per-process anyway, and the built template
+        # references this process's CoreWorker — neither may cross a
+        # process boundary.
+        return (SubmitTemplate, ())
+
+
 class _TaskContext(threading.local):
     def __init__(self):
         self.task_id: Optional[TaskID] = None
@@ -453,6 +510,7 @@ class CoreWorker:
         store_path = store_path or reply["head_store_path"]
         if store_path is None:
             raise RuntimeError("no object store available (no nodes?)")
+        self.store_path = store_path
         self.store = plasma.PlasmaClient(store_path)
         # Workers know their node manager from the spawn env; drivers
         # resolve it once via the nodes table (lazy).
@@ -1130,6 +1188,15 @@ class CoreWorker:
         return sobj.to_bytes(), deps
 
     def deserialize_args(self, args_blob) -> Tuple[tuple, dict]:
+        # Zero-arg calls ship one well-known shared blob (_serialize_args
+        # reuses it); recognize it by value and skip the unpickle — on
+        # the nop-task hot path this is the whole args cost.
+        blob = CoreWorker._EMPTY_ARGS_BLOB
+        if blob is None:
+            blob = serialization.serialize(((), {})).to_bytes()
+            CoreWorker._EMPTY_ARGS_BLOB = blob
+        if args_blob == blob:
+            return (), {}
         if isinstance(args_blob, tuple) and args_blob[0] == "ref":
             oid = args_blob[1]
             failures = self.ensure_local([oid])
@@ -1172,6 +1239,25 @@ class CoreWorker:
                            else v for k, v in proc_kwargs.items()}
         return tuple(proc_args), proc_kwargs
 
+    def _build_template(self, holder: SubmitTemplate, function_key, name,
+                        num_returns, resources, max_retries, strategy,
+                        pg_id, bundle_index, donate_result):
+        from ray_tpu._private import spec_template
+
+        tpl = spec_template.build(dict(
+            job_id=self.job_id, function_key=function_key,
+            num_returns=num_returns, resources=resources, name=name,
+            max_retries=max_retries, retries_left=0,
+            caller_id=self.client_id, owner_node=self.node_id,
+            scheduling_strategy=strategy, placement_group_id=pg_id,
+            placement_group_bundle_index=bundle_index, runtime_env=None,
+            donate_result=donate_result, arg_deps=[], trace_ctx=None))
+        if tpl is not None:
+            tpl.set_verify(bool(config.submit_template_verify))
+        holder.tpl = tpl
+        holder.core = self
+        return tpl
+
     def submit_task(self, function_key: str, args, kwargs, *,
                     name: str = "", num_returns: int = 1,
                     resources: Dict[str, float],
@@ -1180,33 +1266,88 @@ class CoreWorker:
                     placement_group=None,
                     placement_group_bundle_index: int = -1,
                     runtime_env=None,
-                    donate_result: bool = False) -> List[ObjectRef]:
+                    donate_result: bool = False,
+                    template: Optional[SubmitTemplate] = None
+                    ) -> List[ObjectRef]:
         if runtime_env:
             from ray_tpu._private import runtime_env as renv_mod
 
             runtime_env = renv_mod.package_runtime_env(self.kv(), runtime_env)
-        args_blob, deps = self._serialize_args(args, kwargs)
+        if args or kwargs:
+            args_blob, deps = self._serialize_args(args, kwargs)
+        else:
+            # Zero-arg fast path, inlined from _serialize_args.
+            args_blob = CoreWorker._EMPTY_ARGS_BLOB
+            if args_blob is None:
+                args_blob, deps = self._serialize_args(args, kwargs)
+            else:
+                deps = []
         task_id = TaskID.for_task(self.job_id)
-        spec = TaskSpec(
-            task_id=task_id,
-            job_id=self.job_id,
-            function_key=function_key,
-            args=args_blob,
-            arg_deps=deps,
-            num_returns=num_returns,
-            resources=resources,
-            name=name,
-            max_retries=max_retries,
-            caller_id=self.client_id,
-            owner_node=self.node_id,
-            scheduling_strategy=scheduling_strategy,
-            placement_group_id=(placement_group.id
-                                if placement_group is not None else None),
-            placement_group_bundle_index=placement_group_bundle_index,
-            runtime_env=runtime_env,
-            donate_result=donate_result,
-            trace_ctx=_tracing().for_submit(),
-        )
+        trace_ctx = _tracing().for_submit()
+        pg_id = placement_group.id if placement_group is not None else None
+        spec = None
+        if template is not None and not runtime_env \
+                and config.submit_spec_template_enabled:
+            # Pre-serialized spec template (spec_template.py): patch the
+            # variable slots into the RemoteFunction's frozen skeleton —
+            # no TaskSpec.__init__, no per-call pickle.dumps. The wire
+            # bytes come attached as spec._wire for the framing layer.
+            if template.core is self:
+                tpl = template.tpl   # None when the build failed here
+            else:
+                template.uses += 1
+                tpl = None
+                if template.uses >= SubmitTemplate.WARMUP_CALLS:
+                    tpl = self._build_template(
+                        template, function_key, name, num_returns,
+                        resources, max_retries, scheduling_strategy,
+                        pg_id, placement_group_bundle_index,
+                        donate_result)
+            # accepts() inlined: this runs once per submission.
+            if tpl is not None and trace_ctx is None and not deps \
+                    and type(args_blob) is bytes \
+                    and len(args_blob) < tpl.max_args:
+                # Blob-only classic route: when the lease path is known
+                # to decline this shape right now (denial window) — or
+                # the shape was never lease-eligible — ship template-
+                # patched BYTES and never materialize a TaskSpec at all;
+                # the GCS's batch handler builds the only spec object
+                # that ever exists. Skipped in verify mode so make()'s
+                # byte-equality check still covers every submission.
+                lm = self._lease_mgr or self._ensure_lease_mgr()
+                if lm is not None and not tpl._verify \
+                        and (lm.classic_route(resources)
+                             or not lm.eligible(resources,
+                                                scheduling_strategy,
+                                                placement_group,
+                                                runtime_env)):
+                    if lm.submit_classic_patch(tpl, task_id._bytes,
+                                               args_blob, time.time()):
+                        return self._wrap_return_refs(task_id,
+                                                      num_returns, None)
+                spec = (tpl.make(task_id, args_blob, time.time())
+                        if tpl._verify else
+                        tpl.make_lazy(task_id, args_blob, time.time()))
+        if spec is None:
+            spec = TaskSpec(
+                task_id=task_id,
+                job_id=self.job_id,
+                function_key=function_key,
+                args=args_blob,
+                arg_deps=deps,
+                num_returns=num_returns,
+                resources=resources,
+                name=name,
+                max_retries=max_retries,
+                caller_id=self.client_id,
+                owner_node=self.node_id,
+                scheduling_strategy=scheduling_strategy,
+                placement_group_id=pg_id,
+                placement_group_bundle_index=placement_group_bundle_index,
+                runtime_env=runtime_env,
+                donate_result=donate_result,
+                trace_ctx=trace_ctx,
+            )
         # Direct transport first: plain tasks stream to a leased worker
         # (submit() declines when closed/over capacity -> scheduled path).
         lm = self._lease_mgr or self._ensure_lease_mgr()
@@ -1214,8 +1355,48 @@ class CoreWorker:
                 and lm.eligible(resources, scheduling_strategy,
                                 placement_group, runtime_env)
                 and lm.submit(spec)):
-            self.gcs.notify("submit_task", spec)
-        return [ObjectRef(oid) for oid in spec.return_ids()]
+            # Classic (GCS-scheduled) path: dep-free specs coalesce into
+            # submit_task_batch frames (or the shm submit ring) through
+            # the lease manager's classic buffer; dep-carrying specs
+            # keep the single-spec frame on THIS conn — same-conn FIFO
+            # with the refcount flush preserves pin-before-decref.
+            if lm is None or not lm.submit_classic(spec):
+                self.gcs.notify("submit_task", spec)
+        return self._wrap_return_refs(task_id, num_returns, spec)
+
+    def _wrap_return_refs(self, task_id: TaskID, num_returns,
+                          spec) -> List[ObjectRef]:
+        """Owner-side ObjectRefs for a just-submitted task, without the
+        constructor-check layers; ``spec`` is None on the blob-only
+        route (no spec object exists on this side at all)."""
+        refs_t = self._refs
+        if num_returns == 1 or num_returns == "dynamic":
+            # Single visible return (the overwhelmingly common case).
+            rid = ObjectID.__new__(ObjectID)
+            rid._bytes = task_id._bytes + b"\x00\x00\x00\x00"
+            rid._hash = None
+            if spec is not None:
+                spec.__dict__["_rids"] = [rid]
+            if refs_t is not None:
+                refs_t.incref(rid._bytes)
+            ref = ObjectRef.__new__(ObjectRef)
+            ref._id = rid
+            ref._owner_hint = ""
+            return [ref]
+        rids = [ObjectID.for_return(task_id, i) for i in range(num_returns)]
+        if spec is not None:
+            spec.__dict__["_rids"] = rids
+        if refs_t is not None:
+            # One refcount-lock acquisition for the whole batch of
+            # return ids (vs one per ObjectRef constructor).
+            refs_t.incref_many([r._bytes for r in rids])
+        out = []
+        for rid in rids:
+            ref = ObjectRef.__new__(ObjectRef)
+            ref._id = rid
+            ref._owner_hint = ""
+            out.append(ref)
+        return out
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True):

@@ -17,6 +17,7 @@ import collections
 import ctypes
 import inspect
 import os
+import pickle
 import signal
 import sys
 import threading
@@ -186,6 +187,23 @@ class WorkerExecutor:
             # cost both ways — reference: direct transport pipelining).
             with self._cv:
                 for spec in payload:
+                    self._queue.append(("lease_task", (spec, conn)))
+                self._cv.notify()
+        elif mtype == "lease_run_tasks_b":
+            # Batched framing variant: the frame carries pre-pickled
+            # spec blobs (template-patched on the driver) — decode here,
+            # then identical semantics to lease_run_tasks.
+            specs = []
+            for b in payload:
+                try:
+                    specs.append(pickle.loads(b))
+                except Exception:
+                    # Per-blob guard (mirrors the GCS handler): one
+                    # undecodable blob must not tear down the conn and
+                    # fail every other task on the lease.
+                    traceback.print_exc()
+            with self._cv:
+                for spec in specs:
                     self._queue.append(("lease_task", (spec, conn)))
                 self._cv.notify()
         elif mtype == "cancel_task":

@@ -57,12 +57,34 @@ class RemoteFunction:
         self._exported_blob: Optional[bytes] = None
         self._exported_core = None
         self._normalized_resources: Optional[Dict[str, float]] = None
+        # Pre-serialized TaskSpec skeleton: this function's constant
+        # submission fields frozen into a pickled template the core
+        # worker patches per call (spec_template.py). Per-RemoteFunction
+        # because options are immutable here (options() returns a new
+        # one, with its own holder).
+        self._submit_template = worker_mod.SubmitTemplate()
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function '{self._function.__name__}' cannot be called "
             "directly; use '.remote()'.")
+
+    def __getstate__(self):
+        # A RemoteFunction closure-captured into a task must pickle even
+        # after driver-side use: drop the per-process caches — the
+        # CoreWorker handle behind the export-once optimization and the
+        # spec-template holder (frozen caller identity) are both bound
+        # to THIS process. The function blob and content-addressed key
+        # travel; the destination re-exports (a GCS-side dedup no-op).
+        d = dict(self.__dict__)
+        d["_exported_core"] = None
+        d["_submit_template"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._submit_template = worker_mod.SubmitTemplate()
 
     def options(self, **overrides) -> "RemoteFunction":
         rf = RemoteFunction(self._function,
@@ -111,6 +133,7 @@ class RemoteFunction:
             placement_group_bundle_index=bundle_index,
             runtime_env=o["runtime_env"],
             donate_result=bool(o["_donate_result"]),
+            template=self._submit_template,
         )
         if o["num_returns"] == 0:
             return None

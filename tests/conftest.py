@@ -55,6 +55,10 @@ LOCKDEP_MODULES = {
     # process the cluster owns (and its fan-in crosses the NM/GCS agent
     # paths) — witness its lock graph wherever its tests drive it.
     "test_profiler",
+    # The submit fast path adds the classic-batch buffer lock, the ring
+    # writer lock, and the NM's ring-drain thread to the lease/NM/GCS
+    # lock graph — witness the new blocking edges where they are driven.
+    "test_submit_fastpath",
 }
 
 
