@@ -67,6 +67,13 @@ TOGGLES = {
                     "manager (classic-path dep-free submissions become "
                     "a memcpy + doorbell; the NM relays blobs to the "
                     "GCS) vs the socket submit_task_batch path"),
+    "inline_returns": ("RAY_TPU_WORKER_INLINE_RETURNS_ENABLED",
+                       "in-band small-object returns — sub-threshold "
+                       "results skip the plasma put and ride the "
+                       "completion message, backing get() straight from "
+                       "the delivered blob — vs a store put per return "
+                       "and a store read per get (the pre-SCALE_r09 "
+                       "result-return baseline)"),
 }
 
 

@@ -21,10 +21,27 @@ Measures, on one GCS process:
 - multi-driver aggregate throughput (3 driver processes against one
   GCS).
 
+- worker TURNAROUND: tasks/s with results actually ``get()``-ed (not
+  just submitted), a small-object get-latency probe, and a plasma-put
+  probe counting store objects created by sub-threshold results (0
+  with the inline-return fast path on).
+
 Prints one JSON line per metric. Run: python benchmarks/scale_bench.py
 [N_tasks] [K_actors] [--gcs-out-of-process {0,1}]
 [--profile-submit OUT.speedscope.json] [--drivers N]
-[--submit-fastpath {0,1}].
+[--submit-fastpath {0,1}] [--inline-returns {0,1}]
+[--profile-turnaround OUT.speedscope.json].
+
+``--inline-returns`` pins BOTH result-return fast-path stages
+(RAY_TPU_WORKER_INLINE_RETURNS_ENABLED /
+RAY_TPU_TASK_DONE_BATCH_ENABLED) for this run and every child driver:
+the SCALE_r09 A/B is two runs of this script, 1 vs 0, same box, per
+microbench_compare conventions.
+
+``--profile-turnaround`` samples the WORKER side (cluster-wide profile
+fan-out) for the duration of the worker-turnaround phase and writes
+the merged speedscope document (+ .folded sibling): the worker-side
+evidence artifact the ISSUE 14 executor-loop shedding starts from.
 
 ``--drivers N`` sizes the multi-driver phase (default 3) so the
 SCALE_r08 3-driver aggregate — and any other width — reproduces from
@@ -135,7 +152,9 @@ def main():
     args = []
     gcs_oop = None
     profile_out = None
+    profile_turnaround = None
     submit_fastpath = None
+    inline_returns = None
     n_drivers = 3
     i = 0
     while i < len(argv):
@@ -157,6 +176,21 @@ def main():
                 v = argv[i]
             submit_fastpath = v.strip().lower() not in (
                 "0", "false", "off") if v else True
+        elif a.startswith("--inline-returns"):
+            _, eq, v = a.partition("=")
+            if not eq and i + 1 < len(argv) and argv[i + 1].lower() in (
+                    "0", "1", "true", "false", "on", "off"):
+                i += 1
+                v = argv[i]
+            inline_returns = v.strip().lower() not in (
+                "0", "false", "off") if v else True
+        elif a.startswith("--profile-turnaround"):
+            _, eq, v = a.partition("=")
+            if not eq and i + 1 < len(argv):
+                i += 1
+                v = argv[i]
+            profile_turnaround = v or \
+                "PROFILE_worker_turnaround.speedscope.json"
         elif a.startswith("--drivers"):
             _, eq, v = a.partition("=")
             if not eq and i + 1 < len(argv):
@@ -180,6 +214,11 @@ def main():
     if submit_fastpath is not None:
         for k in _SUBMIT_KNOBS:
             os.environ["RAY_TPU_" + k] = "1" if submit_fastpath else "0"
+    _RETURN_KNOBS = ("WORKER_INLINE_RETURNS_ENABLED",
+                     "TASK_DONE_BATCH_ENABLED")
+    if inline_returns is not None:
+        for k in _RETURN_KNOBS:
+            os.environ["RAY_TPU_" + k] = "1" if inline_returns else "0"
 
     import ray_tpu
     from ray_tpu._private.config import config as _cfg
@@ -204,6 +243,14 @@ def main():
                   "ring": bool(_cfg.submit_ring_enabled)},
         "toggle": "--submit-fastpath / RAY_TPU_SUBMIT_{SPEC_TEMPLATE,"
                   "BATCH_FRAMES,RING}_ENABLED"}), flush=True)
+    print(json.dumps({
+        "metric": "inline_returns",
+        "value": {
+            "inline": bool(_cfg.worker_inline_returns_enabled),
+            "task_done_batch": bool(_cfg.task_done_batch_enabled)},
+        "toggle": "--inline-returns / RAY_TPU_WORKER_INLINE_RETURNS_"
+                  "ENABLED + RAY_TPU_TASK_DONE_BATCH_ENABLED"}),
+        flush=True)
     from ray_tpu._private import worker as worker_mod
     try:
         @ray_tpu.remote(resources={"impossible": 1})
@@ -314,12 +361,112 @@ def main():
         for a in actors:
             ray_tpu.kill(a)
 
+        # Worker TURNAROUND: tasks/s with the results actually
+        # get()-ed — the submit fast path made enqueueing nearly free
+        # (SCALE_r08), so this measures the execute->complete->deliver
+        # half: store puts (zero with inline returns), completion
+        # framing, and the driver's wakeup path.
+        w = worker_mod.global_worker()
+
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        @ray_tpu.remote
+        def kb():
+            return b"x" * 1024
+
+        assert ray_tpu.get(kb.remote(), timeout=60) == b"x" * 1024
+        prof_thread = None
+        prof_result = {}
+        m_turn = 2000
+        if profile_turnaround:
+            from ray_tpu.experimental.state import api as state_api
+            import threading as _threading
+
+            def _capture():
+                try:
+                    prof_result["profiles"] = state_api.profile(
+                        duration_s=6.0, hz=250)
+                except Exception as e:
+                    prof_result["error"] = f"{type(e).__name__}: {e}"
+
+            prof_thread = _threading.Thread(target=_capture, daemon=True)
+            prof_thread.start()
+            time.sleep(0.5)   # let the windows open before the burst
+            m_turn = 6000     # keep workers busy for the whole window
+        puts_before = w.store.stats()["num_objects"]
+        t0 = time.perf_counter()
+        done = ray_tpu.get([nop.remote() for _ in range(m_turn)],
+                           timeout=300)
+        dt = time.perf_counter() - t0
+        assert len(done) == m_turn
+        lat = []
+        for _ in range(40):
+            t1 = time.perf_counter()
+            assert len(ray_tpu.get(kb.remote(), timeout=60)) == 1024
+            lat.append(time.perf_counter() - t1)
+        lat.sort()
+        plasma_puts = w.store.stats()["num_objects"] - puts_before
+        print(json.dumps({
+            "metric": "worker_turnaround_tasks_per_s",
+            "value": round(m_turn / dt, 1), "unit": "tasks/s (get()-ed)",
+            "n": m_turn,
+            "small_get_p50_ms": round(1000 * lat[len(lat) // 2], 3),
+            "small_get_p95_ms": round(
+                1000 * lat[int(len(lat) * 0.95)], 3),
+            "plasma_puts_observed": plasma_puts}), flush=True)
+        if prof_thread is not None:
+            prof_thread.join(timeout=30)
+            profiles = prof_result.get("profiles") or []
+            workers_only = [p for p in profiles
+                            if p.get("kind") == "worker"]
+            if workers_only:
+                from ray_tpu._private.profiler import (
+                    folded_lines, speedscope_document)
+
+                doc = speedscope_document(
+                    workers_only,
+                    name=f"scale_bench worker turnaround phase "
+                         f"({m_turn} nops, {dt:.2f}s)")
+                with open(profile_turnaround, "w") as f:
+                    json.dump(doc, f)
+                folded_path = profile_turnaround.rsplit(
+                    ".speedscope.json", 1)[0] + ".folded"
+                with open(folded_path, "w") as f:
+                    f.write("\n".join(folded_lines(workers_only)) + "\n")
+                print(json.dumps({
+                    "metric": "worker_turnaround_profile",
+                    "value": sum(p.get("samples", 0)
+                                 for p in workers_only),
+                    "unit": "samples", "processes": len(workers_only),
+                    "out": profile_turnaround,
+                    "folded": folded_path}), flush=True)
+            else:
+                print(json.dumps({
+                    "metric": "worker_turnaround_profile",
+                    "value": 0,
+                    "error": prof_result.get("error",
+                                             "no worker profiles")}),
+                    flush=True)
+
+        # Settle: the turnaround phase above leaves THIS driver holding
+        # leases on the whole shared pool; child drivers starting into
+        # that pay fairness revocation + decline backoff per worker
+        # (measured: first-get stalls up to ~1.9s, waves 3x slower).
+        # Wait out the idle return so the multi-driver phase measures
+        # multi-driver turnaround, not the lease-handoff tail.
+        time.sleep(float(_cfg.lease_idle_timeout_s) + 0.5)
+
         # Multi-driver concurrency: D separate driver processes hammer
         # the SAME GCS with task waves (the reference's many-client
         # regime; SCALE_r04 only ever measured one driver). Reports
         # aggregate throughput and the worst per-driver p95.
         address = worker_mod.global_worker().gcs_address
-        per_driver = 600
+        # 3000 (was 600): each child now runs long enough that steady-
+        # state turnaround dominates warmup — SCALE_r08's 600-task runs
+        # bounced 7.1-9.1k aggregate on identical code.
+        per_driver = 3000
         child_src = f"""
 import json, sys, time
 sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})

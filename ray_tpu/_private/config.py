@@ -167,7 +167,10 @@ _d("object_spilling_threshold", 0.8,
 
 # --- raylet / scheduling ----------------------------------------------------
 _d("num_workers_soft_limit", -1,
-   "Max pooled workers per node; -1 means num_cpus.")
+   "Elastic ceiling of the shared CPU worker pool: queue-depth "
+   "pressure grows the pool up to this many workers, and idle workers "
+   "above the num_cpus base retire after worker_idle_timeout_s. "
+   "-1 means num_cpus plus a small burst headroom.")
 _d("worker_start_timeout_s", 30.0, "Timeout for a worker process to register.")
 _d("scheduler_spread_threshold", 0.5,
    "Hybrid policy: prefer local node until utilization exceeds this "
@@ -272,6 +275,54 @@ _d("submit_ring_bytes", 4 * 1024 * 1024,
    "Data capacity of the per-client submit ring. At ~200 bytes per "
    "nop-task spec blob the default holds ~20k in-flight submissions "
    "before ring-full spills to the socket path.")
+
+# --- worker turnaround fast path (inline returns / batched completions) ----
+_d("worker_inline_returns_enabled", True,
+   "In-band small-object returns (the result-return twin of the driver "
+   "submit fast path; reference: returns at or below "
+   "max_direct_call_object_size ride the task reply instead of plasma): "
+   "a result whose framed serialization is OOB-free and at or under "
+   "worker_inline_return_max skips the store put and ships as a blob "
+   "inside the completion message. Lease-path blobs land straight in "
+   "the submitting driver's inline cache; the GCS holds the cluster-"
+   "visible copy in a per-job bounded table that backs get() and "
+   "deserialize_args directly, materializing to a node's store only "
+   "under table pressure. Off = every return pays a plasma put and "
+   "every get() a store read (the pre-SCALE_r09 baseline; the "
+   "'inline_returns' toggle in benchmarks/microbench_compare.py and "
+   "--inline-returns in benchmarks/scale_bench.py).")
+_d("worker_inline_return_max", 8192,
+   "Largest framed result (bytes) that may travel in-band. Results "
+   "over it — and ALL results carrying pickle-5 out-of-band buffers "
+   "(numpy, device arrays) — take the store path. 0 disables inline "
+   "returns regardless of worker_inline_returns_enabled.")
+_d("worker_inline_cache_bytes", 32 * 1024 * 1024,
+   "Byte budget of each process's local inline-object LRU (delivered "
+   "lease results + object_locations inline replies). Eviction is "
+   "safe — the GCS inline table / store path serves a miss — so this "
+   "only bounds driver memory, not correctness.")
+_d("gcs_inline_table_bytes", 64 * 1024 * 1024,
+   "Per-job byte budget of the GCS inline-object table. Pressure "
+   "materializes the job's oldest inline entries into a node's object "
+   "store (worker_inline_spills_total counts them); entries are "
+   "dropped only after the store copy's location report confirms.")
+_d("task_done_flush_slack_s", 0.002,
+   "Upper bound on how long a worker may hold a finished task's "
+   "completion record while its queue is non-empty (a slack-timer "
+   "thread flushes past it). Within the window, back-to-back fast "
+   "tasks coalesce into one completion frame; a slow successor task "
+   "can delay a finished predecessor's result by at most this long. "
+   "Queue-empty still flushes immediately — a lone task never waits.")
+_d("task_done_batch_enabled", True,
+   "Batched completion framing end-to-end (the completion twin of "
+   "submit_task_batch): workers coalesce classic-path task_done "
+   "notifies into task_done_batch frames of pre-pickled records — "
+   "flushed the moment the worker's queue empties, so a lone task "
+   "never waits — the node manager relays the blobs to the GCS "
+   "without unpickling, and the GCS processes the batch under one "
+   "lock acquisition, waking parked get() waiters once per batch "
+   "instead of once per task. Off = one task_done notify per task "
+   "(the pre-SCALE_r09 baseline).")
 
 # --- direct task transport (worker leases) ---------------------------------
 _d("lease_enabled", True,

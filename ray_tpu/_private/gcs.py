@@ -62,8 +62,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import protocol
+from ray_tpu._private import inline_objects, protocol
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu.util import metrics as metrics_util
 from ray_tpu._private.task_spec import (
     ActorCreationSpec,
     ActorTaskSpec,
@@ -298,6 +299,24 @@ class GcsServer:
         self._pgs: Dict[bytes, PgEntry] = {}
         self._named_pgs: Dict[str, bytes] = {}
 
+        # Inline-object table (inline_objects.py): the cluster-visible
+        # copy of in-band small returns, listed in the directory under
+        # the pseudo node ::inline; per-job byte-bounded, with pressure
+        # materializing the oldest entries into a real node's store.
+        # Guarded by _obj_lock alongside the directory (the table's own
+        # lock is a leaf for the lock-free stats read).
+        self._inline_tbl = inline_objects.InlineTable(
+            int(_cfg.gcs_inline_table_bytes))
+        # Objects freed while a store_inline_objects materialization was
+        # in flight: oid -> (target node, freed-at). The target is not
+        # in the directory yet, so the free's delete fan-out misses it;
+        # the late add_object_locations confirm consults this tombstone
+        # and queues a delete instead of resurrecting the object.
+        # Guarded by _obj_lock; expired by the housekeeping timer.
+        self._freed_mid_spill: Dict[bytes, Tuple[str, float]] = {}
+        # Per-node delete notifications queued under _obj_lock (sends
+        # must not run under a shard lock); drained by the timer.
+        self._deferred_deletes: Dict[str, List[bytes]] = {}
         # object directory: object_id bytes -> set(node_id); sizes for stats
         self._obj_locations: Dict[bytes, Set[str]] = collections.defaultdict(set)
         self._obj_sizes: Dict[bytes, int] = {}
@@ -416,6 +435,20 @@ class GcsServer:
                 due = [o for o, t in self._pending_free.items() if now >= t]
                 if due:
                     deletes = self._free_now(due)
+                if self._deferred_deletes:
+                    for nid, oids in self._deferred_deletes.items():
+                        deletes.setdefault(nid, []).extend(oids)
+                    self._deferred_deletes.clear()
+                if self._freed_mid_spill:
+                    # A confirm that never arrives (NM died with the
+                    # store copy) must not pin the tombstone forever —
+                    # 60 s far exceeds the spill retry window. Stamps
+                    # are monotonic (the timer's ``now`` is wall time).
+                    mono = time.monotonic()
+                    for o in [o for o, (_n, t)
+                              in self._freed_mid_spill.items()
+                              if mono - t >= 60.0]:
+                        del self._freed_mid_spill[o]
             self._send_deletes(deletes)
             # Scheduling-domain housekeeping. Health checks / recovering-
             # actor expiry nest actor (and obj, for node death) forward.
@@ -432,6 +465,18 @@ class GcsServer:
                     self._try_schedule()
             self._sample_shard_metrics(now)
             self._sample_self_stats(now)
+            if now - getattr(self, "_last_spill_sweep", 0.0) >= \
+                    inline_objects.InlineTable.SPILL_RETRY_S:
+                # Inline-table pressure retry: re-select spills for any
+                # still-over-budget job. insert() only re-selects when
+                # the SAME job inserts again, so a store_inline_objects
+                # notify lost to NM death/send failure after a job went
+                # quiet would otherwise hold its over-budget bytes
+                # forever. Table lock is a leaf; runs outside shards.
+                self._last_spill_sweep = now
+                overdue = self._inline_tbl.pressure_spills()
+                if overdue:
+                    self._send_inline_spills(overdue)
             for w in expired:
                 try:
                     w.conn.reply(w.msg_id, {
@@ -488,6 +533,11 @@ class GcsServer:
                 wait_h.observe(time.perf_counter() - t0,
                                tags={"shard": name})
                 depth_g.set(float(depth()), tags={"shard": name})
+        try:
+            _n, b_inline = self._inline_tbl.stats()
+            _inline_metrics()[1].set(float(b_inline))
+        except Exception:
+            pass
 
     @staticmethod
     def _read_self_rss() -> Optional[int]:
@@ -1357,32 +1407,163 @@ class GcsServer:
             except Exception:
                 pass
 
+    def _inline_insert_locked(self, oid: bytes, blob: bytes,
+                              node_id: str) -> Tuple[bool, List[tuple]]:
+        """Register an in-band return in the inline table (caller holds
+        _obj_lock). Returns (registered, spills): ``registered`` False
+        means a copy (inline or store) already exists — the caller must
+        NOT add a ::inline directory entry for it, or a redelivered
+        completion landing AFTER a spill-confirm would register a
+        phantom ::inline location with no backing table entry (which
+        also suppresses lineage reconstruction when the store copy's
+        node later dies). ``spills`` are the entries the insertion
+        pushed over the producing job's byte budget, shipped via
+        _send_inline_spills AFTER releasing the shard locks."""
+        if self._obj_locations.get(oid):
+            return False, []   # a copy (inline or store) already exists
+        try:
+            job = ObjectID(oid).job_id().binary()
+        except Exception:
+            job = b""
+        return True, self._inline_tbl.insert(oid, blob, job, node_id)
+
+    def _send_inline_spills(self, spills) -> None:
+        """Materialize pressure-evicted inline entries into a node's
+        store (store_inline_objects). Runs outside every shard lock;
+        node lookups are routing reads. The table entry is dropped only
+        when the node's add_object_locations confirms the store copy."""
+        if not spills:
+            return
+        by_node: Dict[str, List[Tuple[bytes, bytes]]] = {}
+        for oid, blob, node_id in spills:
+            by_node.setdefault(node_id, []).append((oid, blob))
+        sent = 0
+        for node_id, objs in by_node.items():
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                # Producer gone: any live node's store serves reads.
+                node = next((n for n in list(self._nodes.values())
+                             if n.alive), None)
+            if node is None:
+                continue   # no nodes: retried on the next pressure tick
+            if node.node_id != node_id:
+                # Re-targeted: the confirm will come from THIS node, so
+                # retries and free-tombstones must name it, not the
+                # dead producer.
+                for oid, _blob in objs:
+                    if not self._inline_tbl.note_spill_target(
+                            oid, node.node_id):
+                        # Freed while the spill was in flight: point
+                        # the tombstone at the real target.
+                        with self._obj_lock:
+                            tomb = self._freed_mid_spill.get(oid)
+                            if tomb is not None:
+                                self._freed_mid_spill[oid] = \
+                                    (node.node_id, tomb[1])
+            try:
+                node.conn.notify("store_inline_objects", {"objects": objs})
+                sent += len(objs)
+            except Exception:
+                continue
+        if sent:
+            try:
+                _inline_metrics()[0].inc(sent)
+            except Exception:
+                pass
+
+    def _apply_task_done_locked(self, p: dict, node_id: str,
+                                new_oids: Set[bytes],
+                                spills: list) -> None:
+        """Apply one completion record. Caller holds _sched_lock +
+        _obj_lock; locations are registered QUIETLY — the caller owes
+        one _fulfill_obj_waiters_many(new_oids) pass for the whole
+        batch — and inline-table pressure spills accumulate into
+        ``spills`` for post-lock dispatch."""
+        tid = p["task_id"]
+        entry = self._running_tasks.pop(tid, None)
+        if entry is not None:
+            spec, run_node = entry
+            self._release_for(spec, run_node)
+        pinned_spec = self._actor_task_pins.pop(tid, None)
+        if pinned_spec is not None:
+            self._unpin_task_args(pinned_spec)
+        inline = p.get("inline") or {}
+        for oid, size in p.get("objects", []):
+            if oid in inline:
+                # In-band return: the GCS inline table IS the copy; the
+                # directory lists it under the ::inline pseudo node.
+                registered, sp = self._inline_insert_locked(
+                    oid, inline[oid], node_id)
+                if not registered:
+                    # Redelivery after the object is already resolvable
+                    # (table entry or a spilled store copy): adding a
+                    # location would orphan ::inline from the table.
+                    continue
+                spills.extend(sp)
+                loc = inline_objects.INLINE_LOCATION
+            else:
+                loc = node_id
+            for spec2 in self._add_location_obj_quiet(oid, loc, size):
+                self._enqueue_task(spec2)
+            new_oids.add(oid)
+        if entry is not None and \
+                getattr(entry[0], "num_returns", None) == "dynamic":
+            # Dynamic yields are reconstructable: re-running the
+            # generator re-stores every index idempotently.
+            for oid, _size in p.get("objects", []):
+                self._producing_task[oid] = tid
+        if p["status"] == "crashed" and entry is not None:
+            self._handle_task_failure(entry[0],
+                                      p.get("error", "worker died"))
+        elif entry is not None:
+            self._unpin_task_args(entry[0])
+
     def _h_task_done(self, conn, p, msg_id):
         """Node manager reports task completion (success or failure)."""
+        new_oids: Set[bytes] = set()
+        spills: list = []
         with self._sched_lock:
-            tid = p["task_id"]
-            entry = self._running_tasks.pop(tid, None)
-            if entry is not None:
-                spec, node_id = entry
-                self._release_for(spec, node_id)
             with self._obj_lock:
-                pinned_spec = self._actor_task_pins.pop(tid, None)
-                if pinned_spec is not None:
-                    self._unpin_task_args(pinned_spec)
-                for oid, size in p.get("objects", []):
-                    self._add_location(oid, p["node_id"], size)
-                if entry is not None and \
-                        getattr(entry[0], "num_returns", None) == "dynamic":
-                    # Dynamic yields are reconstructable: re-running the
-                    # generator re-stores every index idempotently.
-                    for oid, _size in p.get("objects", []):
-                        self._producing_task[oid] = tid
-                if p["status"] == "crashed" and entry is not None:
-                    self._handle_task_failure(entry[0],
-                                              p.get("error", "worker died"))
-                elif entry is not None:
-                    self._unpin_task_args(entry[0])
+                self._apply_task_done_locked(p, p["node_id"], new_oids,
+                                             spills)
+                if new_oids:
+                    self._fulfill_obj_waiters_many(new_oids)
             self._try_schedule()
+        self._send_inline_spills(spills)
+
+    def _h_task_done_batch(self, conn, p, msg_id):
+        """Batched completions relayed by a node manager as pre-pickled
+        records (the completion twin of _h_submit_task_batch: the worker
+        pickled each record, the NM relayed the blobs untouched, this is
+        the first decode). One shard-lock acquisition, ONE parked-waiter
+        pass, and one scheduling pass per batch — a 64-task batch wakes
+        get() waiters once, not 64 times."""
+        node_id = p["node_id"]
+        records = []
+        for b in p["blobs"]:
+            try:
+                records.append(pickle.loads(b))
+            except Exception:
+                # Per-blob guard: one undecodable record must not drop
+                # the rest of the batch.
+                logger.exception("task_done_batch: undecodable record")
+        if not records:
+            return
+        try:
+            _inline_metrics()[2].observe(float(len(records)))
+        except Exception:
+            pass
+        new_oids: Set[bytes] = set()
+        spills: list = []
+        with self._sched_lock:
+            with self._obj_lock:
+                for r in records:
+                    self._apply_task_done_locked(r, node_id, new_oids,
+                                                 spills)
+                if new_oids:
+                    self._fulfill_obj_waiters_many(new_oids)
+            self._try_schedule()
+        self._send_inline_spills(spills)
 
     # ------------------------------------------------- worker leases
     # (direct task transport, reference: direct_task_transport.h:75 —
@@ -1500,6 +1681,8 @@ class GcsServer:
         with placement otherwise."""
         node_id = p["node_id"]
         woken: List[Any] = []
+        new_oids: Set[bytes] = set()
+        spills: list = []
         with self._obj_lock:
             for t in p["tasks"]:
                 spec = t.get("spec")
@@ -1510,18 +1693,39 @@ class GcsServer:
                     if getattr(spec, "retries_left", None) in (None, 0):
                         spec.retries_left = spec.max_retries
                     self._retain_spec_locked(spec)
+                inline = t.get("inline") or {}
                 for oid, size in t.get("objects", ()):
-                    woken.extend(self._add_location_obj(oid, node_id, size))
+                    if oid in inline:
+                        # In-band lease return: the blob was delivered
+                        # to the submitting driver at completion; this
+                        # flush makes the GCS table the cluster-visible
+                        # copy (other clients resolve it through
+                        # object_locations, no node hop).
+                        registered, sp = self._inline_insert_locked(
+                            oid, inline[oid], node_id)
+                        if not registered:
+                            continue   # redelivery: already resolvable
+                        spills.extend(sp)
+                        loc = inline_objects.INLINE_LOCATION
+                    else:
+                        loc = node_id
+                    woken.extend(
+                        self._add_location_obj_quiet(oid, loc, size))
+                    new_oids.add(oid)
                 if spec is not None and \
                         getattr(spec, "num_returns", None) == "dynamic":
                     for oid, _size in t.get("objects", ()):
                         self._producing_task[oid] = \
                             spec.task_id.binary()
+            if new_oids:
+                # One parked-waiter pass per report batch.
+                self._fulfill_obj_waiters_many(new_oids)
         if woken:
             with self._sched_lock:
                 for spec in woken:
                     self._enqueue_task(spec)
                 self._try_schedule()
+        self._send_inline_spills(spills)
 
     def _handle_task_failure(self, spec: TaskSpec, reason: str):
         """System failure (worker/node death): retry or store error objects."""
@@ -1611,12 +1815,39 @@ class GcsServer:
         returns the dep-parked specs this copy unblocked (some may still
         wait on other deps — _enqueue_task re-parks those). Caller holds
         _obj_lock."""
+        woken = self._add_location_obj_quiet(oid, node_id, size)
+        self._fulfill_obj_waiters(oid, failed=False)
+        return woken
+
+    def _add_location_obj_quiet(self, oid: bytes, node_id: str,
+                                size: int = 0) -> List[Any]:
+        """_add_location_obj WITHOUT the waiter pass — batched
+        completion handlers register a whole batch of locations first
+        and fulfill parked waiters once (_fulfill_obj_waiters_many),
+        so a 64-task batch costs one waiter scan, not 64. Caller holds
+        _obj_lock and owes a fulfillment pass for the oid."""
+        if self._freed_mid_spill:
+            tomb = self._freed_mid_spill.get(oid)
+            if tomb is not None and tomb[0] == node_id:
+                # Pressure-spill confirm for an object freed while the
+                # materialization was in flight: the store copy must
+                # die, not enter the directory.
+                del self._freed_mid_spill[oid]
+                self._deferred_deletes.setdefault(
+                    node_id, []).append(oid)
+                return []
+        if oid in self._inline_tbl and \
+                node_id != inline_objects.INLINE_LOCATION:
+            # A store copy materialized (pressure spill confirmed, or a
+            # retry re-ran the task): the directory now points at a real
+            # node, the table entry retires.
+            self._inline_tbl.drop(oid)
+            self._obj_locations[oid].discard(
+                inline_objects.INLINE_LOCATION)
         self._obj_locations[oid].add(node_id)
         if size:
             self._obj_sizes[oid] = size
-        woken = self._waiting_tasks.pop(oid, None) or []
-        self._fulfill_obj_waiters(oid, failed=False)
-        return woken
+        return self._waiting_tasks.pop(oid, None) or []
 
     def _fulfill_obj_waiters(self, oid: bytes, failed: bool):
         done = []
@@ -1626,6 +1857,23 @@ class GcsServer:
                 (w.failed if failed else w.ready).add(oid)
                 if len(w.ready) + len(w.failed) >= w.num_needed or not w.pending:
                     done.append(w)
+        self._reply_done_waiters(done)
+
+    def _fulfill_obj_waiters_many(self, oids: Set[bytes]):
+        """One waiter pass for a whole completion batch (the per-batch
+        wakeup of parked get()/wait() callers). Caller holds _obj_lock."""
+        done = []
+        for w in self._obj_waiters:
+            hit = w.pending & oids
+            if not hit:
+                continue
+            w.pending -= hit
+            w.ready |= hit
+            if len(w.ready) + len(w.failed) >= w.num_needed or not w.pending:
+                done.append(w)
+        self._reply_done_waiters(done)
+
+    def _reply_done_waiters(self, done: List[_ObjWaiter]):
         for w in done:
             self._obj_waiters.remove(w)
             try:
@@ -1659,11 +1907,18 @@ class GcsServer:
             for oid in p["object_ids"]:
                 nodes = [self._nodes[n] for n in self._obj_locations.get(oid, ())
                          if n in self._nodes and self._nodes[n].alive]
-                out[oid] = {
+                ent = {
                     "locations": [(n.node_id, n.address) for n in nodes],
                     "size": self._obj_sizes.get(oid, 0),
                     "failed": self._failed_objects.get(oid),
                 }
+                blob = self._inline_tbl.get(oid)
+                if blob is not None:
+                    # In-band object: the directory lookup IS the
+                    # transfer — the reply carries the value, and the
+                    # client parks it in its local inline cache.
+                    ent["inline"] = blob
+                out[oid] = ent
             conn.reply(msg_id, out)
 
     def _h_wait_for_objects(self, conn, p, msg_id):
@@ -1723,7 +1978,17 @@ class GcsServer:
         the lock (_send_deletes)."""
         by_node: Dict[str, List[bytes]] = collections.defaultdict(list)
         for oid in ids:
+            spill_target = self._inline_tbl.spill_inflight(oid)
+            if spill_target is not None:
+                # A materialization is mid-flight to a node that is not
+                # in the directory yet: tombstone so its confirm report
+                # deletes the store copy instead of re-registering it.
+                self._freed_mid_spill[oid] = (spill_target,
+                                              time.monotonic())
+            self._inline_tbl.drop(oid)
             for nid in self._obj_locations.pop(oid, ()):  # noqa: B909
+                if nid == inline_objects.INLINE_LOCATION:
+                    continue   # the table entry above WAS the copy
                 by_node[nid].append(oid)
             self._obj_sizes.pop(oid, None)
             self._pending_free.pop(oid, None)
@@ -2699,6 +2964,9 @@ class GcsServer:
             out["obj_waiters"] = len(self._obj_waiters)
             out["pending_free"] = len(self._pending_free)
             out["tracked_objects"] = len(self._obj_locations)
+            n_inline, b_inline = self._inline_tbl.stats()
+            out["inline_objects"] = n_inline
+            out["inline_bytes"] = b_inline
         with self._kv_lock:
             out["publish_outbox"] = len(self._pub_q)
         # GCS-process self stats (pid/rss/cpu/listener threads): sampled
@@ -2780,45 +3048,62 @@ class _ActorCreationShim:
         self.placement_group_id = None
 
 
-# Shard observability metrics (lazy: the metrics module starts a
-# reporter thread; only build them once the GCS timer first samples).
-_shard_metric_cache = None
-_shard_metric_lock = threading.Lock()
+# Shard observability metrics (lazy_metrics: building them starts the
+# reporter thread; deferred to the GCS timer's first sample).
 
 
-def _shard_metrics():
-    global _shard_metric_cache
-    if _shard_metric_cache is None:
-        with _shard_metric_lock:
-            if _shard_metric_cache is None:
-                from ray_tpu.util import metrics
+def _build_inline_metrics():
+    """(spills counter, table-occupancy gauge, completion-batch-size
+    histogram)."""
+    from ray_tpu.util import metrics
 
-                wait_h = metrics.Histogram(
-                    "gcs_shard_lock_wait_seconds",
-                    "Sampled GCS shard-lock acquire wait (timer probe)",
-                    boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025,
-                                0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
-                                0.5, 1.0],
-                    tag_keys=("shard",))
-                depth_g = metrics.Gauge(
-                    "gcs_shard_queue_depth",
-                    "Per-domain GCS backlog (queued tasks / pending "
-                    "actors / parked waiters+frees / publish outbox)",
-                    tag_keys=("shard",))
-                rss_g = metrics.Gauge(
-                    "gcs_process_rss_bytes",
-                    "Resident memory of the process hosting the GCS")
-                cpu_g = metrics.Gauge(
-                    "gcs_process_cpu_percent",
-                    "CPU utilization of the process hosting the GCS "
-                    "(sampled over the shard-metrics period)")
-                thr_g = metrics.Gauge(
-                    "gcs_listener_threads",
-                    "Per-connection GCS listener threads currently alive")
-                metrics.start_reporter()
-                _shard_metric_cache = (wait_h, depth_g, rss_g, cpu_g,
-                                       thr_g)
-    return _shard_metric_cache
+    spills = metrics.Counter(
+        "worker_inline_spills_total",
+        "Inline returns materialized into a node's object "
+        "store under GCS inline-table pressure")
+    occupancy = metrics.Gauge(
+        "gcs_inline_table_bytes",
+        "Bytes held by the GCS inline-object table across "
+        "all jobs (per-job bound: gcs_inline_table_bytes "
+        "config knob)")
+    batch_h = metrics.Histogram(
+        "task_done_batch_size",
+        "Completion records per task_done_batch frame "
+        "(worker -> NM -> GCS)",
+        boundaries=[1, 2, 4, 8, 16, 32, 64, 128])
+    return (spills, occupancy, batch_h)
+
+
+def _build_shard_metrics():
+    from ray_tpu.util import metrics
+
+    wait_h = metrics.Histogram(
+        "gcs_shard_lock_wait_seconds",
+        "Sampled GCS shard-lock acquire wait (timer probe)",
+        boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                    0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0],
+        tag_keys=("shard",))
+    depth_g = metrics.Gauge(
+        "gcs_shard_queue_depth",
+        "Per-domain GCS backlog (queued tasks / pending "
+        "actors / parked waiters+frees / publish outbox)",
+        tag_keys=("shard",))
+    rss_g = metrics.Gauge(
+        "gcs_process_rss_bytes",
+        "Resident memory of the process hosting the GCS")
+    cpu_g = metrics.Gauge(
+        "gcs_process_cpu_percent",
+        "CPU utilization of the process hosting the GCS "
+        "(sampled over the shard-metrics period)")
+    thr_g = metrics.Gauge(
+        "gcs_listener_threads",
+        "Per-connection GCS listener threads currently alive")
+    return (wait_h, depth_g, rss_g, cpu_g, thr_g)
+
+
+_inline_metrics = metrics_util.lazy_metrics(_build_inline_metrics)
+_shard_metrics = metrics_util.lazy_metrics(_build_shard_metrics)
 
 
 def p_kind(spec) -> str:

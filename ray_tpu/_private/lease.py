@@ -890,30 +890,66 @@ class LeaseManager:
                 lease.inflight -= 1
                 self._task_lease.pop(rep["task_id"], None)
                 done_specs.append(spec)
+                inline = rep.get("inline")
+                if inline:
+                    # In-band returns: the value is IN this message —
+                    # park it in the caller's inline cache BEFORE waking
+                    # getters, so the woken get() resolves with zero
+                    # store/GCS round trips. (The cache's lock is a
+                    # leaf; safe under the manager lock.)
+                    cache = self._w._inline
+                    for oid, blob in inline.items():
+                        cache.put(oid, blob)
                 for oid, size in rep["objects"]:
                     ent = self._inflight.get(oid)
                     if ent is not None:
+                        if inline and oid in inline:
+                            # No store copy exists anywhere: getters
+                            # must never dial the producing node for
+                            # this oid (worker._wait_lease_local honors
+                            # the flag; the GCS inline table serves a
+                            # local-cache miss).
+                            ent["inline"] = True
                         ent["info"] = (rep["node_id"], lease.nm_address,
                                        size)
                         ent["ev"].set()
-                self._reports.append({"spec": spec,
-                                      "node_id": rep["node_id"],
-                                      "objects": rep["objects"]})
+                report = {"spec": spec,
+                          "node_id": rep["node_id"],
+                          "objects": rep["objects"]}
+                if inline:
+                    report["inline"] = inline
+                self._reports.append(report)
             st = self._shapes.get(lease.shape_key)
             if st is not None and not lease.dead:
                 drained.extend(self._sendbuf.pop(lease, ()))
-                while st.queue and lease.inflight < self._depth:
-                    nxt = st.queue.popleft()
-                    self._reserve_locked(lease, nxt)
-                    drained.append(nxt)
+                # Low-watermark refill: top the pipeline back up only
+                # once it has drained to half depth, so refills ship as
+                # half-depth batches. Refilling on every completion
+                # locks in a size-1 ping-pong — the worker flushes the
+                # moment its queue empties, the 1-spec refill lands
+                # after that flush, and from then on every frame in
+                # both directions carries exactly one task (measured:
+                # ~3k tasks/s/worker; batched refill amortizes the
+                # per-frame cost with no completion-latency cost).
+                if st.queue and lease.inflight <= self._depth // 2:
+                    while st.queue and lease.inflight < self._depth:
+                        nxt = st.queue.popleft()
+                        self._reserve_locked(lease, nxt)
+                        drained.append(nxt)
             if lease.inflight == 0 and not drained:
                 lease.idle_since = time.monotonic()
             drain_done = (lease.draining and lease.inflight == 0
                           and not lease.pending)
             if drain_done:
                 lease.draining = False
-        for spec in done_specs:
-            self._decref_deps(spec)
+        # Batched decrefs on the completion frame: one deque extend for
+        # the whole batch, not one _decref_deps round per spec.
+        refs = self._w._refs
+        if refs is not None:
+            deps = [d.binary() for spec in done_specs
+                    for d in spec.arg_deps]
+            if deps:
+                refs.decref_many(deps)
         if drained:
             self._send(lease, drained)
         if drain_done:
@@ -1060,6 +1096,15 @@ class LeaseManager:
         with self._lock:
             return self._inflight.get(oid)
 
+    def inflight_map(self) -> Dict[bytes, Dict[str, Any]]:
+        """The oid -> completion-entry map itself, for lock-free
+        membership probes (GIL-atomic dict reads) on the get()/wait()
+        hot scans — a ctypes store probe per ref was 66% of a get()'s
+        MainThread when every ref was a pending lease task (r10 driver
+        profile). Staleness only costs the caller the always-correct
+        slow path; anything beyond `in` goes through peek()."""
+        return self._inflight
+
     def note_worker_killed(self, worker_id, reason: str) -> None:
         with self._lock:
             self._kill_reasons[worker_id] = reason
@@ -1186,22 +1231,39 @@ class LeaseManager:
             return
         by_node: Dict[str, List[dict]] = {}
         for r in reports:
-            by_node.setdefault(r["node_id"], []).append(
-                {"spec": r["spec"], "objects": r["objects"]})
-        ok = True
-        for node_id, tasks in by_node.items():
+            by_node.setdefault(r["node_id"], []).append(r)
+        failed: List[dict] = []
+        sent: List[dict] = []
+        for node_id, group in by_node.items():
+            tasks = []
+            for r in group:
+                task = {"spec": r["spec"], "objects": r["objects"]}
+                if r.get("inline"):
+                    # The GCS's copy of in-band returns: after this
+                    # flush the inline table (not this driver) is the
+                    # cluster-visible holder, so local cache eviction
+                    # stays safe.
+                    task["inline"] = r["inline"]
+                tasks.append(task)
             try:
                 self._w.gcs.notify("lease_task_events",
                                    {"node_id": node_id, "tasks": tasks})
+                sent.extend(group)
             except Exception:
-                ok = False
-        if ok:
-            # Locations are now (or will momentarily be) in the GCS: the
-            # local fast-path entries can go.
-            with self._lock:
-                for r in reports:
-                    for oid, _size in r["objects"]:
-                        self._inflight.pop(oid, None)
+                # With inline returns the report IS the only durable
+                # copy of the values: re-queue for the next flush tick
+                # (at-least-once; the GCS inline insert and location
+                # adds are both redelivery-idempotent).
+                failed.extend(group)
+        with self._lock:
+            if failed and not self._closed:
+                self._reports = failed + self._reports
+            # Locations for the sent groups are now (or will
+            # momentarily be) in the GCS: the local fast-path
+            # entries can go.
+            for r in sent:
+                for oid, _size in r["objects"]:
+                    self._inflight.pop(oid, None)
 
     def _reap_idle(self):
         now = time.monotonic()

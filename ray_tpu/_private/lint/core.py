@@ -53,6 +53,11 @@ CONTROL_PLANE = (
     # a blocking call under its lock or an unbounded park here stalls
     # the submit pipeline of a whole client.
     "ray_tpu/_private/submit_ring.py",
+    # The inline-object tables back every get()/deserialize_args and
+    # sit under the GCS object shard and the lease completion handler —
+    # a blocking call under their leaf locks would invert the whole
+    # result-return pipeline's lock graph.
+    "ray_tpu/_private/inline_objects.py",
     "ray_tpu/parallel/collective.py",
     "ray_tpu/train/worker_group.py",
     # The LLM serving tier: the engine's scheduler thread and the

@@ -142,6 +142,7 @@ class WorkerHandle:
     dedicated: bool = False        # not returned to the pool
     env_key: Optional[tuple] = None  # spawn-time env_extra fingerprint
     tpu_idle_since: float = 0.0    # parked in the chip-bound idle pool
+    idle_since: float = 0.0        # parked in the CPU idle pool
     isolated: bool = False         # runtime-env cwd/sys.path: never pooled
     pending_pushes: List[tuple] = field(default_factory=list)
     killed_by_us: bool = False
@@ -195,6 +196,18 @@ class NodeManager:
         self._task_queue: List[TaskSpec] = []
         self._num_cpus = num_cpus
         self._max_pool = max(1, int(num_cpus))
+        # Elastic pool ceiling: queue-depth pressure may grow the shared
+        # CPU pool to this many workers (num_workers_soft_limit; -1 =
+        # base pool + small headroom); idle workers above the base pool
+        # retire after worker_idle_timeout_s, so a burst's extra workers
+        # don't linger as resident interpreters.
+        soft = int(config.num_workers_soft_limit)
+        self._pool_cap = soft if soft > 0 else self._max_pool + 2
+        # A ceiling below the base pool bounds the base pool too:
+        # prestart/refill/shrink all track _max_pool, and a stated
+        # limit of 2 on an 8-CPU node must not keep 8 interpreters
+        # resident.
+        self._max_pool = min(self._max_pool, self._pool_cap)
         self._free_tpu_chips: Set[int] = set(range(int(num_tpus)))
         # Chip-bound workers parked between TPU tasks, keyed by
         # (chip_count, env_key): a second same-shape TPU task reuses the
@@ -811,6 +824,22 @@ class NodeManager:
                         self._tpu_idle[key] = keep
                     else:
                         self._tpu_idle.pop(key, None)
+                # Elastic-pool shrink: idle CPU workers above the base
+                # pool retire after worker_idle_timeout_s (growth was
+                # queue-pressure-driven; the base pool stays warm).
+                idle_timeout = float(config.worker_idle_timeout_s)
+                n_pool = len([x for x in self._workers.values()
+                              if not x.dedicated and x.state != "dead"])
+                if n_pool > self._max_pool:
+                    for w in list(self._idle):
+                        if n_pool <= self._max_pool:
+                            break
+                        if (w.state == IDLE and w.idle_since
+                                and now - w.idle_since > idle_timeout):
+                            self._idle.remove(w)
+                            w.killed_by_us = True
+                            expired.append(w)
+                            n_pool -= 1
             for w in hung:
                 logger.warning(
                     "worker %s hung during startup for a pending lease "
@@ -936,6 +965,19 @@ class NodeManager:
             "RAY_TPU_NODE_ID": self.node_id,
             "RAY_TPU_SESSION_DIR": self.session_dir,
         }
+        # Ship this NM's non-default config to the worker (the analog of
+        # serve.start shipping _system_config to worker actors): zygote-
+        # forked workers inherit the ZYGOTE's env — which deliberately
+        # strips RAY_TPU_* — so without this, knobs set on the driver
+        # (inline-return thresholds, A/B toggles, test system_configs)
+        # would silently default in every worker. worker_main applies it
+        # through the typed registry before building its CoreWorker.
+        cfg_diff = config.diff_nondefault()
+        if cfg_diff:
+            try:
+                ident["RAY_TPU_SYSTEM_CONFIG"] = json.dumps(cfg_diff)
+            except (TypeError, ValueError):
+                pass   # non-JSON value snuck in: workers keep defaults
         # Workers resolve by-reference pickles (functions defined in driver
         # modules) by importing the same modules, so they need the driver's
         # import roots (reference: runtime_env working_dir ships driver code
@@ -1204,19 +1246,43 @@ class NodeManager:
                     logger.exception("pool refill spawn failed")
         self._dispatch_queued()
 
+    def _store_raw(self, oid: bytes, data: bytes) -> bool:
+        """Write one pre-framed blob into the store (create/copy/seal;
+        an existing object counts as success — idempotent redelivery)."""
+        try:
+            buf = self.store.create(oid, len(data))
+        except plasma.ObjectExistsError:
+            return True
+        except plasma.StoreFullError:
+            if self._spill_bytes(len(data) * 2) <= 0:
+                return False
+            try:
+                buf = self.store.create(oid, len(data))
+            except plasma.ObjectExistsError:
+                return True
+            except Exception:
+                return False
+        try:
+            buf[:] = data
+        finally:
+            del buf
+        self.store.seal(oid)
+        return True
+
     def _store_errors(self, object_ids: List[bytes], err: BaseException):
-        """Materialize an exception as the value of each object id."""
+        """Materialize an exception as the value of each object id. The
+        exception is serialized and FRAMED once; each additional return
+        id costs only the store memcpy of those same bytes."""
         out = []
-        blob = serialization.serialize(err)
+        data = serialization.serialize(err).to_bytes()
         for oid in object_ids:
             try:
-                self.store.put_serialized(oid, blob)
-            except plasma.ObjectExistsError:
-                pass
+                if not self._store_raw(oid, data):
+                    continue
             except Exception:
                 logger.exception("failed storing error object")
                 continue
-            out.append((oid, blob.total_size()))
+            out.append((oid, len(data)))
         if out:
             try:
                 self.gcs.notify("add_object_locations", {
@@ -1225,20 +1291,44 @@ class NodeManager:
                 pass
         return out
 
+    def _on_store_inline_objects(self, p):
+        """GCS inline-table pressure: materialize evicted in-band
+        returns into this node's store. The GCS keeps its table entry
+        until the add_object_locations report below confirms the store
+        copy (keep-until-confirmed — a reader can never find the object
+        in neither place), then drops it."""
+        out = []
+        for oid, data in p.get("objects", []):
+            try:
+                if self._store_raw(oid, data):
+                    out.append((oid, len(data)))
+            except Exception:
+                logger.exception("inline-object materialization failed")
+        if out:
+            try:
+                self.gcs.notify("add_object_locations", {
+                    "node_id": self.node_id, "objects": out})
+            except Exception:
+                pass
+
     def _report_task_done(self, task_id: bytes, status: str, objects,
-                          error: Optional[str] = None):
+                          error: Optional[str] = None,
+                          inline: Optional[dict] = None):
         with self._lock:
             held = self._res_held_tasks.pop(task_id, None)
             if held:
                 self._local_avail.release(held)
+        msg = {
+            "task_id": task_id,
+            "status": status,
+            "objects": objects or [],
+            "node_id": self.node_id,
+            "error": error,
+        }
+        if inline:
+            msg["inline"] = inline
         try:
-            self.gcs.notify("task_done", {
-                "task_id": task_id,
-                "status": status,
-                "objects": objects or [],
-                "node_id": self.node_id,
-                "error": error,
-            })
+            self.gcs.notify("task_done", msg)
         except Exception:
             pass
 
@@ -1256,6 +1346,8 @@ class NodeManager:
                 self._on_cancel_task(payload)
             elif mtype == "store_error_objects":
                 self._on_store_error_objects(payload)
+            elif mtype == "store_inline_objects":
+                self._on_store_inline_objects(payload)
             elif mtype == "delete_objects":
                 for oid in payload["object_ids"]:
                     self.store.delete(oid)
@@ -1400,8 +1492,7 @@ class NodeManager:
                 # its ledger hold intact, not leak the hold by unwinding
                 # out of this handler (r7 finding c).
                 self._task_queue.append(spec)
-                n = len([x for x in self._workers.values() if not x.dedicated])
-                refill = n < self._max_pool + 2
+                refill = self._pool_pressure_locked()
         if w is None:
             if refill:
                 try:
@@ -1411,6 +1502,39 @@ class NodeManager:
                                      "stays queued")
             return
         self._push_task(w, spec)
+
+    def _pool_pressure_locked(self) -> bool:
+        """Elastic pool growth signal (caller holds the lock): spawn
+        another shared worker when queued tasks outnumber the spawns
+        already in flight for the queue, and the pool is under its
+        elastic ceiling (num_workers_soft_limit). The reaper retires
+        idle workers above the base pool, so pressure-grown workers are
+        transient, not a permanently bigger pool."""
+        n = 0
+        spares = 0
+        for x in self._workers.values():
+            if x.dedicated or x.state == "dead":
+                continue
+            n += 1
+            if (x.state == STARTING and x.lease_reply is None
+                    and x.leased_conn is None and x.actor_id is None):
+                spares += 1
+        # Only CPU-servable specs are pressure: a chip-starved TPU spec
+        # waits for chips, and a pool worker spawned for it could never
+        # run it (it would ramp the pool to its cap with idle spawns).
+        queued_cpu = sum(1 for s in self._task_queue
+                         if s.resources.get(TPU, 0) <= 0)
+        return queued_cpu > spares and n < self._pool_cap
+
+    def _maybe_grow_pool(self) -> None:
+        with self._lock:
+            grow = bool(self._task_queue) and self._pool_pressure_locked()
+        if grow:
+            try:
+                self._spawn_worker()
+            except BaseException:
+                logger.exception("elastic pool spawn failed; queue "
+                                 "retries on the next dispatch trigger")
 
     def _materialize_runtime_env(self, runtime_env):
         """Fetch + extract this env's packages from the GCS KV into the
@@ -1610,6 +1734,10 @@ class NodeManager:
                         dispatch = ("cpu", spec, w)
                         break
             if dispatch is None:
+                # Queue still non-empty with nothing to run it on:
+                # elastic growth (bounded by _pool_cap) instead of
+                # waiting for a completion to free a worker.
+                self._maybe_grow_pool()
                 return
             kind, spec, w = dispatch
             if kind == "tpu":
@@ -1879,6 +2007,8 @@ class NodeManager:
                 self._on_register_worker(conn, payload, msg_id)
             elif mtype == "task_done":
                 self._on_task_done(conn, payload)
+            elif mtype == "task_done_batch":
+                self._on_task_done_batch(conn, payload)
             elif mtype == "actor_ready":
                 self.gcs.notify("actor_state", {
                     "actor_id": payload["actor_id"], "state": "ALIVE"})
@@ -2034,7 +2164,7 @@ class NodeManager:
                         w.state = BUSY
                     else:
                         w.state = IDLE
-                        self._idle.append(w)
+                        self._park_idle_locked(w)
                 # Deliver parked pushes UNDER the lock, before any other
                 # path can observe w.conn non-None: _on_submit_actor_task
                 # sends inline the moment it sees a conn, and an inline
@@ -2561,9 +2691,44 @@ class NodeManager:
             w.leased_conn = None
             w.lease_tag = None
             w.lease_grant = None
-            self._idle.append(w)
+            self._park_idle_locked(w)
         self._release_local_grant(tag)
         self._dispatch_queued()
+
+    def _park_idle_locked(self, w: WorkerHandle) -> None:
+        """Return a CPU pool worker to the idle list (caller holds the
+        lock). idle_since feeds the elastic-pool reaper: idle workers
+        above the base pool retire after worker_idle_timeout_s."""
+        w.idle_since = time.time()
+        self._idle.append(w)
+
+    def _release_worker_after_tasks_locked(self, w: WorkerHandle,
+                                           conn) -> None:
+        """Shared tail of task_done / task_done_batch: once the worker's
+        current_tasks drained, park it (CPU pool / TPU shape pool) or
+        retire a one-shot dedicated worker. Caller holds the lock."""
+        release_worker = (w.state == BUSY and not w.current_tasks)
+        if release_worker and not w.dedicated:
+            w.state = IDLE
+            self._park_idle_locked(w)
+        if release_worker and w.dedicated and w.actor_id is None:
+            if w.tpu_chips and not w.isolated and not self._shutdown:
+                # Park the chip-bound worker for same-shape reuse:
+                # the next TPU task of this shape skips the
+                # multi-second fresh-spawn + XLA client init.
+                w.state = IDLE
+                w.tpu_idle_since = time.time()
+                self._tpu_idle.setdefault(
+                    (len(w.tpu_chips), w.env_key), []).append(w)
+            else:
+                # one-shot dedicated worker (runtime_env): retire it
+                for chip in w.tpu_chips:
+                    self._free_tpu_chips.add(chip)
+                w.tpu_chips = []
+                try:
+                    conn.notify("exit")
+                except protocol.ConnectionClosed:
+                    pass
 
     def _on_task_done(self, conn, p):
         wid = conn.meta.get("worker_id")
@@ -2571,31 +2736,37 @@ class NodeManager:
             w = self._workers.get(wid)
             if w is None:
                 return
-            spec = w.current_tasks.pop(p["task_id"], None)
-            release_worker = (w.state == BUSY and not w.current_tasks)
-            if release_worker and not w.dedicated:
-                w.state = IDLE
-                self._idle.append(w)
-            if release_worker and w.dedicated and w.actor_id is None:
-                if w.tpu_chips and not w.isolated and not self._shutdown:
-                    # Park the chip-bound worker for same-shape reuse:
-                    # the next TPU task of this shape skips the
-                    # multi-second fresh-spawn + XLA client init.
-                    w.state = IDLE
-                    w.tpu_idle_since = time.time()
-                    self._tpu_idle.setdefault(
-                        (len(w.tpu_chips), w.env_key), []).append(w)
-                else:
-                    # one-shot dedicated worker (runtime_env): retire it
-                    for chip in w.tpu_chips:
-                        self._free_tpu_chips.add(chip)
-                    w.tpu_chips = []
-                    try:
-                        conn.notify("exit")
-                    except protocol.ConnectionClosed:
-                        pass
+            w.current_tasks.pop(p["task_id"], None)
+            self._release_worker_after_tasks_locked(w, conn)
         self._report_task_done(p["task_id"], p["status"], p.get("objects"),
-                               error=p.get("error"))
+                               error=p.get("error"),
+                               inline=p.get("inline"))
+        self._dispatch_queued()
+
+    def _on_task_done_batch(self, conn, payload):
+        """Batched completion frame from a worker: (task_id, blob)
+        pairs. The task ids ride OUTSIDE the blobs, so the worker/ledger
+        bookkeeping happens here while the records relay to the GCS
+        WITHOUT unpickling (mirroring the submit-ring relay — the GCS
+        handler is the first decode)."""
+        wid = conn.meta.get("worker_id")
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None:
+                return
+            for tid, _blob in payload:
+                w.current_tasks.pop(tid, None)
+            self._release_worker_after_tasks_locked(w, conn)
+            for tid, _blob in payload:
+                held = self._res_held_tasks.pop(tid, None)
+                if held:
+                    self._local_avail.release(held)
+        try:
+            self.gcs.notify("task_done_batch", {
+                "node_id": self.node_id,
+                "blobs": [b for _tid, b in payload]})
+        except Exception:
+            pass
         self._dispatch_queued()
 
     def _on_fetch_object(self, conn, p, msg_id):

@@ -18,6 +18,7 @@ from __future__ import annotations
 import atexit
 import collections
 import concurrent.futures
+import itertools
 import queue
 import hashlib
 import os
@@ -26,12 +27,17 @@ import threading
 import time
 import uuid
 import weakref
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import cloudpickle
 
 from ray_tpu import exceptions
-from ray_tpu._private import device_objects, protocol, serialization
+from ray_tpu._private import (
+    device_objects,
+    inline_objects,
+    protocol,
+    serialization,
+)
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu._private.task_spec import (
@@ -537,6 +543,23 @@ class CoreWorker:
         # reference, zero copies, never touching store or GCS.
         self._device_local: "weakref.WeakValueDictionary[bytes, Any]" = \
             weakref.WeakValueDictionary()
+        # In-band small-object returns (inline_objects.py): blobs
+        # delivered by lease completions / object_locations replies,
+        # backing get()/deserialize_args with zero store round trips.
+        # Byte-bounded LRU; a miss falls back to the GCS/store path.
+        self._inline = inline_objects.InlineCache(
+            int(config.worker_inline_cache_bytes))
+        # Return oids of OUR in-flight submissions, a bounded
+        # insertion-ordered window (entries popped as gets resolve
+        # them, oldest halved out past _PENDING_RETURNS_MAX). The
+        # get()/wait() hot scans probe it — lock-free, GIL-atomic —
+        # to skip the per-ref store FFI probe for results that cannot
+        # be local yet: under load each ctypes call pays a GIL
+        # reacquisition behind this process's busy conn threads
+        # (~180us measured vs 0.6us idle), and the scan paid it per
+        # ref. Staleness is safe: a stale entry only routes one get
+        # through the always-correct GCS wait path.
+        self._pending_returns: Dict[bytes, None] = {}
         self._nm_conns: Dict[str, protocol.Conn] = {}
         self._nm_lock = threading.Lock()
         # actor_id bytes -> {"address": str|None, "pending": [...], "info": {}}
@@ -843,7 +866,25 @@ class CoreWorker:
         Returns {id: failure_reason} for ids that failed instead. Raises
         GetTimeoutError on timeout.
         """
-        missing = [o for o in id_bytes_list if not self.store.contains(o)]
+        lm = self._lease_mgr
+        inflight = lm.inflight_map() if lm is not None else None
+        pend = self._pending_returns
+        missing = []
+        for o in id_bytes_list:
+            if o in self._inline:
+                pend.pop(o, None)
+                continue
+            if (inflight is not None and o in inflight) or o in pend:
+                # A return of one of OUR in-flight submissions: the
+                # lease completion event / GCS wait path decides
+                # readiness — not a ctypes store probe per ref, which
+                # inline returns made pure waste (the result never
+                # touches the store, and under load each FFI call pays
+                # a GIL reacquisition behind the busy conn threads).
+                missing.append(o)
+                continue
+            if not self.store.contains(o):
+                missing.append(o)
         failures: Dict[bytes, str] = {}
         if not missing:
             return failures
@@ -872,14 +913,20 @@ class CoreWorker:
                 pending.discard(oid)
             ready = [o for o in reply["ready"] if o in pending]
             if ready:
-                self._pull_objects(ready)
+                inlined = self._pull_objects(ready)
                 still_missing = False
                 for o in ready:
                     # A pull can be undone before we read it (restored
                     # object re-spilled under memory pressure) — only
                     # count objects that actually landed; retry the rest.
-                    if self.store.contains(o):
+                    # Inline objects "land" in the process-local cache
+                    # (an oid whose blob came back inline counts even if
+                    # a tiny cache already churned it out: the reader's
+                    # _fetch_inline backstop owns that case).
+                    if o in inlined or o in self._inline \
+                            or self.store.contains(o):
                         pending.discard(o)
+                        pend.pop(o, None)
                     else:
                         still_missing = True
                 if still_missing:
@@ -910,7 +957,18 @@ class CoreWorker:
             if info is None:          # task fell back to the scheduled path
                 rest.append(oid)
                 continue
-            if self.store.contains(oid):
+            if oid in self._inline or self.store.contains(oid):
+                # Inline lease results were delivered straight into the
+                # local cache by the completion handler — no store read,
+                # no fetch.
+                self._pending_returns.pop(oid, None)
+                continue
+            if ent.get("inline"):
+                # Delivered in-band but churned out of a small cache:
+                # NO store copy exists on any node — dialing the
+                # producer would park in its store wait. The GCS inline
+                # table serves it on the directory path.
+                rest.append(oid)
                 continue
             node_id, nm_address, _size = info
             if node_id != self.node_id and \
@@ -1026,16 +1084,28 @@ class CoreWorker:
             "node_id": self.node_id, "objects": [(oid, total)]})
         return True
 
-    def _pull_objects(self, id_bytes_list: List[bytes]) -> None:
-        """Fetch objects that are ready somewhere into the local store."""
-        to_pull = [o for o in id_bytes_list if not self.store.contains(o)]
+    def _pull_objects(self, id_bytes_list: List[bytes]) -> Set[bytes]:
+        """Fetch objects that are ready somewhere into the local store
+        (or, for inline objects, into the local inline cache — the
+        object_locations reply carries the blob itself). Returns the
+        oids served inline."""
+        inlined: Set[bytes] = set()
+        to_pull = [o for o in id_bytes_list
+                   if o not in self._inline and not self.store.contains(o)]
         if not to_pull:
-            return
+            return inlined
         locs = self.gcs.request("object_locations", {"object_ids": to_pull})
         for oid in to_pull:
             if self.store.contains(oid):
                 continue
             info = locs.get(oid) or {}
+            blob = info.get("inline")
+            if blob is not None:
+                # The object's only copy is the GCS inline table: the
+                # directory lookup IS the transfer (no node hop).
+                self._inline.put(oid, blob)
+                inlined.add(oid)
+                continue
             for node_id, address in info.get("locations", []):
                 if node_id == self.node_id:
                     # Listed as local but store.contains said no: spilled
@@ -1057,6 +1127,7 @@ class CoreWorker:
                     continue
                 if self._fetch_from(address, oid):
                     break
+        return inlined
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -1090,17 +1161,80 @@ class CoreWorker:
             if oid in local_hits:
                 out.append(local_hits[oid])
                 continue
+            out.append(self._resolve_ready_value(oid, failures))
+        return out[0] if single else out
+
+    def _resolve_ready_value(self, oid: bytes, failures: Dict[bytes, str]):
+        """Value of a ready object via the inline/store cascade (shared
+        by get() and deserialize_args — the fallback ORDER is the
+        contract): local inline cache -> store -> directory backstop
+        (_fetch_inline) -> late store copy. ``failures`` is the
+        ensure_local failure map for the batch; a task error re-raises
+        as its original exception."""
+        # In-band small returns: the framed blob is already in this
+        # process (lease delivery or object_locations reply) — no
+        # store round trip at all. Same error semantics as the
+        # store path below.
+        blob = self._inline.get(oid)
+        if blob is None and not self.store.contains(oid):
+            # Ready but in neither the local cache nor the store:
+            # either an inline blob churned out of a small cache
+            # (the GCS table still holds it — one directory round
+            # trip, cheaper than parking on the store) or a store
+            # object mid-seal (falls through to the store wait).
+            if oid in failures:
+                raise _error_from_reason(failures[oid])
+            blob = self._fetch_inline(oid)
+        if blob is not None:
+            value = serialization.loads_oob(blob)
+        else:
             if oid in failures and not self.store.contains(oid):
                 raise _error_from_reason(failures[oid])
             value, ok = self.store.get_value(oid, timeout_ms=30_000)
             if not ok:
-                raise exceptions.ObjectLostError(oid.hex())
-            if isinstance(value, exceptions.RayTaskError):
-                raise value.as_instanceof_cause()
-            if isinstance(value, exceptions.RayTpuError):
-                raise value
-            out.append(value)
-        return out[0] if single else out
+                blob = self._fetch_inline(oid)
+                if blob is not None:
+                    value = serialization.loads_oob(blob)
+                else:
+                    # The backstop pull may have landed a STORE
+                    # copy (table entry spilled to a node) rather
+                    # than a blob.
+                    value, ok = self.store.get_value(
+                        oid, timeout_ms=1_000)
+                    if not ok:
+                        raise exceptions.ObjectLostError(oid.hex())
+        if isinstance(value, exceptions.RayTaskError):
+            raise value.as_instanceof_cause()
+        if isinstance(value, exceptions.RayTpuError):
+            raise value
+        return value
+
+    def _fetch_inline(self, oid: bytes) -> Optional[bytes]:
+        """Directory-lookup backstop for an inline object missing from
+        the local cache AND the store. One object_locations round trip
+        resolves BOTH ways the blob can have moved on: the reply still
+        carries it (GCS inline table holds the copy — returned
+        directly, so a disabled/churned local cache cannot drop it), or
+        the table entry was pressure-materialized into some node's
+        store — then the copy is pulled local and the caller's store
+        path serves it (ignoring store locations here would stall 30 s
+        on the local store and raise a spurious ObjectLostError for an
+        object alive on another node)."""
+        try:
+            locs = self.gcs.request("object_locations",
+                                    {"object_ids": [oid]})
+        except Exception:
+            return None
+        info = locs.get(oid) or {}
+        blob = info.get("inline")
+        if blob is not None:
+            return blob
+        for node_id, address in info.get("locations", []):
+            if node_id == self.node_id:
+                continue   # local: the caller's store wait covers it
+            if self._fetch_from(address, oid):
+                break
+        return None
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True
@@ -1115,16 +1249,30 @@ class CoreWorker:
         if self._lease_mgr is not None:
             # About to block: ship any coalesced submit batches first.
             self._lease_mgr.flush_sends()
-        local = {o for o in ids if self.store.contains(o)}
-        ready_set = set(local)
-        if self._lease_mgr is not None and len(ready_set) < num_returns:
-            # Completed-but-not-yet-flushed lease tasks are ready too.
-            for o in ids:
-                if o not in ready_set:
-                    ent = self._lease_mgr.peek(o)
-                    if ent is not None and ent["ev"].is_set() \
-                            and ent.get("info") is not None:
-                        ready_set.add(o)
+        lm = self._lease_mgr
+        inflight = lm.inflight_map() if lm is not None else None
+        pend = self._pending_returns
+        ready_set = set()
+        for o in ids:
+            if o in self._inline:
+                ready_set.add(o)
+                continue
+            if inflight is not None and o in inflight:
+                # Completed-but-not-yet-flushed lease tasks are ready
+                # too; pending ones wait on their completion event —
+                # either way no per-ref ctypes store probe.
+                ent = lm.peek(o)
+                if ent is not None and ent["ev"].is_set() \
+                        and ent.get("info") is not None:
+                    ready_set.add(o)
+                continue
+            if o in pend:
+                # A still-pending return of our own submission: the
+                # GCS wait below is authoritative (and a stale window
+                # entry only costs that one batched round trip).
+                continue
+            if self.store.contains(o):
+                ready_set.add(o)
         if len(ready_set) < num_returns:
             # Server-parked wait (see _wait_missing): unbounded only
             # when the caller passed no timeout — wait()'s contract.
@@ -1136,6 +1284,11 @@ class CoreWorker:
                 else timeout + 30.0)
             ready_set.update(reply["ready"])
             ready_set.update(reply.get("failed") or {})
+        # Resolved returns leave the pending window: the next wait() on
+        # the same ref probes the local store directly instead of paying
+        # the GCS round trip again (poll loops call wait() repeatedly).
+        for o in ready_set:
+            pend.pop(o, None)
         ready, not_ready = [], []
         for r in refs:
             if r.binary() in ready_set and len(ready) < num_returns:
@@ -1150,8 +1303,18 @@ class CoreWorker:
         return ready, not_ready
 
     def free(self, refs: Sequence[ObjectRef]):
-        self.gcs.request("free_objects",
-                         {"object_ids": [r.binary() for r in refs]})
+        ids = [r.binary() for r in refs]
+        # Explicit free must also evict locally-cached inline copies —
+        # a later get() must see the loss, not a stale cached value.
+        # OTHER processes' inline caches are not invalidated (no
+        # client-side delete fan-out): a borrower that already pulled
+        # the blob may keep serving it until its LRU churns. free()
+        # while another process still uses the ref is undefined for
+        # store objects too (the reference's free() contract) — inline
+        # returns just fail stale instead of failing lost.
+        for oid in ids:
+            self._inline.pop(oid)
+        self.gcs.request("free_objects", {"object_ids": ids})
 
     # ---------------------------------------------------------------- tasks
 
@@ -1223,16 +1386,10 @@ class CoreWorker:
             need = [o for o in need if o not in resolved]
             failures = self.ensure_local(need) if need else {}
             for oid in need:
-                if oid in failures and not self.store.contains(oid):
-                    raise _error_from_reason(failures[oid])
-                value, ok = self.store.get_value(oid, timeout_ms=30_000)
-                if not ok:
-                    raise exceptions.ObjectLostError(oid.hex())
-                if isinstance(value, exceptions.RayTaskError):
-                    raise value.as_instanceof_cause()
-                if isinstance(value, exceptions.RayTpuError):
-                    raise value
-                resolved[oid] = value
+                # Inline args: an upstream task's in-band return used as
+                # this task's argument deserializes straight from the
+                # delivered blob (ensure_local pulled it into the cache).
+                resolved[oid] = self._resolve_ready_value(oid, failures)
             proc_args = [resolved[a.id_bytes] if isinstance(a, _ObjArg) else a
                          for a in proc_args]
             proc_kwargs = {k: resolved[v.id_bytes] if isinstance(v, _ObjArg)
@@ -1364,6 +1521,27 @@ class CoreWorker:
                 self.gcs.notify("submit_task", spec)
         return self._wrap_return_refs(task_id, num_returns, spec)
 
+    _PENDING_RETURNS_MAX = 65536
+
+    def _note_pending_returns(self, oid_bytes_list) -> None:
+        """Record just-minted return oids in the pending window (see
+        _pending_returns in __init__). Amortized O(1): past the cap the
+        oldest half is dropped in one pass — stale entries are safe."""
+        pend = self._pending_returns
+        for b in oid_bytes_list:
+            pend[b] = None
+        if len(pend) > self._PENDING_RETURNS_MAX:
+            try:
+                stale = list(itertools.islice(
+                    iter(pend), self._PENDING_RETURNS_MAX // 2))
+            except RuntimeError:
+                # Another thread mutated the dict mid-scan (inserts and
+                # pops are lock-free): skip this trim, the next submit
+                # past the cap retries.
+                return
+            for b in stale:
+                pend.pop(b, None)
+
     def _wrap_return_refs(self, task_id: TaskID, num_returns,
                           spec) -> List[ObjectRef]:
         """Owner-side ObjectRefs for a just-submitted task, without the
@@ -1379,6 +1557,7 @@ class CoreWorker:
                 spec.__dict__["_rids"] = [rid]
             if refs_t is not None:
                 refs_t.incref(rid._bytes)
+            self._note_pending_returns((rid._bytes,))
             ref = ObjectRef.__new__(ObjectRef)
             ref._id = rid
             ref._owner_hint = ""
@@ -1390,6 +1569,7 @@ class CoreWorker:
             # One refcount-lock acquisition for the whole batch of
             # return ids (vs one per ObjectRef constructor).
             refs_t.incref_many([r._bytes for r in rids])
+        self._note_pending_returns([r._bytes for r in rids])
         out = []
         for rid in rids:
             ref = ObjectRef.__new__(ObjectRef)
@@ -1588,7 +1768,9 @@ class CoreWorker:
             trace_ctx=_tracing().for_submit(),
         )
         self._dispatch_actor_task(spec)
-        return [ObjectRef(oid) for oid in spec.return_ids()]
+        rids = spec.return_ids()
+        self._note_pending_returns([r._bytes for r in rids])
+        return [ObjectRef(oid) for oid in rids]
 
     def _dispatch_actor_task(self, spec: ActorTaskSpec):
         aid = spec.actor_id.binary()

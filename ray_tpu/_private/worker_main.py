@@ -26,12 +26,33 @@ import traceback
 from typing import Any, Dict, Optional
 
 from ray_tpu import exceptions
-from ray_tpu._private import device_objects, protocol, serialization
+from ray_tpu._private import (
+    device_objects,
+    inline_objects,
+    protocol,
+    serialization,
+)
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import ActorID, JobID, TaskID
 from ray_tpu._private.task_spec import ActorCreationSpec, ActorTaskSpec, TaskSpec
 from ray_tpu._private.worker import CoreWorker, set_global_worker
 from ray_tpu.object_store import plasma
+from ray_tpu.util import metrics as metrics_util
+
+
+def _build_worker_metrics():
+    """Worker-side fast-path metrics (created on first flush, not per
+    task; the reporter ships them to the GCS metrics table)."""
+    from ray_tpu.util import metrics
+
+    inline_total = metrics.Counter(
+        "worker_inline_returns_total",
+        "Task returns shipped in-band inside the completion "
+        "message (zero object-store touches)")
+    return (inline_total,)
+
+
+_worker_metrics = metrics_util.lazy_metrics(_build_worker_metrics)
 
 
 class WorkerExecutor:
@@ -62,8 +83,30 @@ class WorkerExecutor:
 
         signal.signal(signal.SIGUSR1, self._on_cancel_signal)
 
-        self._lease_results: list = []
-        self._lease_results_lock = threading.Lock()
+        # Worker-turnaround fast path knobs, snapshotted once (the
+        # spawning NM ships its non-default config in the worker env,
+        # applied in main() before this executor exists).
+        self._inline_max = (int(config.worker_inline_return_max)
+                            if bool(config.worker_inline_returns_enabled)
+                            else 0)
+        self._batch_done = bool(config.task_done_batch_enabled)
+        # Unified completion buffer: (conn_or_None, record) — None routes
+        # to the NM as a task_done_batch frame (classic path), a conn is
+        # a lease holder's direct connection (lease_tasks_done). One
+        # flush policy for both: ship when the queue empties (a lone
+        # task never waits) or at _COMPLETION_BATCH buffered; while the
+        # queue is non-empty a slack-timer thread bounds how long a
+        # finished result can sit behind the next task's execution —
+        # flushing inline before every next task instead (the previous
+        # policy) pinned completion frames at size 1 for fast tasks.
+        self._completions: list = []
+        self._completions_lock = threading.Lock()
+        self._flush_slack = max(
+            0.0005, float(config.task_done_flush_slack_s))
+        self._flush_arm = threading.Event()
+        self._flush_stop = threading.Event()
+        threading.Thread(target=self._completion_flush_loop, daemon=True,
+                         name="rtpu-completion-flush").start()
         self._event_buf: list = []
         self._event_lock = threading.Lock()
         self._event_stop = threading.Event()
@@ -116,6 +159,10 @@ class WorkerExecutor:
         from ray_tpu.util import tracing
 
         tracing.set_sink(self._record_span_event)
+        # Cached module ref: _set_ctx runs per task and the import
+        # machinery's sys.modules probe was visible in worker-side
+        # profiles at nop-task rates.
+        self._tracing = tracing
 
     # ------------------------------------------------------------- plumbing
 
@@ -279,22 +326,27 @@ class WorkerExecutor:
                 if mtype == "run_task" and \
                         payload.task_id.binary() == task_id:
                     self._queue.remove(item)
-                    self._store_error_returns(
+                    objects, inline = self._store_error_returns(
                         payload, exceptions.TaskCancelledError(
                             task_id.hex()))
-                    self._task_done(payload, "error", [], "cancelled")
+                    self._task_done(payload, "error", objects,
+                                    "cancelled", inline)
+                    self._flush_completions()
                     return
                 if mtype == "lease_task" and \
                         payload[0].task_id.binary() == task_id:
                     self._queue.remove(item)
                     spec, lconn = payload
-                    objects = self._store_error_returns(
+                    objects, inline = self._store_error_returns(
                         spec, exceptions.TaskCancelledError(task_id.hex()))
-                    self._queue_lease_result(lconn, {
+                    rec = {
                         "task_id": task_id,
                         "status": "error", "objects": objects,
-                        "error": "cancelled", "node_id": self.node_id})
-                    self._flush_lease_results()
+                        "error": "cancelled", "node_id": self.node_id}
+                    if inline:
+                        rec["inline"] = inline
+                    self._queue_lease_result(lconn, rec)
+                    self._flush_completions()
                     return
             if self._current_task_id == task_id:
                 self._cancel_requested = task_id
@@ -325,11 +377,6 @@ class WorkerExecutor:
                 if mtype == "run_task":
                     self._execute_task(payload)
                 elif mtype == "lease_task":
-                    # Completed results must never wait behind the NEXT
-                    # task's execution (a long task would sit on a fast
-                    # predecessor's result): ship anything buffered first.
-                    if self._lease_results:
-                        self._flush_lease_results()
                     self._execute_lease_task(*payload)
                 elif mtype == "create_actor":
                     self._create_actor(payload)
@@ -352,12 +399,20 @@ class WorkerExecutor:
 
     # ------------------------------------------------------------ execution
 
-    def _store_returns(self, spec, result) -> list:
+    def _store_returns(self, spec, result) -> tuple:
+        """Seal the task's returns; returns (objects, inline) where
+        ``objects`` is the [(oid, size), ...] completion manifest and
+        ``inline`` maps the subset of oids whose value travels IN-BAND
+        (framed blob in the completion message, zero store touches) —
+        OOB-free results at or under ``worker_inline_return_max``.
+        Device arrays always carry out-of-band buffers, so they always
+        take the store path (and keep their staging/donation
+        semantics)."""
         if getattr(spec, "num_returns", None) == "dynamic":
             return self._store_dynamic_returns(spec, result)
         ids = spec.return_ids()
         if not ids:
-            return []
+            return [], {}
         if len(ids) == 1:
             values = [result]
         else:
@@ -368,10 +423,17 @@ class WorkerExecutor:
                     f"{type(result).__name__}")
             values = list(result)
         out = []
+        inline: Dict[bytes, bytes] = {}
         donate = bool(getattr(spec, "donate_result", False))
+        inline_max = 0 if donate else self._inline_max
         donate_after = []
         for oid, value in zip(ids, values):
             sobj = serialization.serialize(value)
+            if inline_objects.eligible(sobj, inline_max):
+                blob = sobj.to_bytes()
+                inline[oid.binary()] = blob
+                out.append((oid.binary(), len(blob)))
+                continue
             try:
                 self.core.store.put_serialized(oid.binary(), sobj)
             except plasma.ObjectExistsError:
@@ -390,9 +452,9 @@ class WorkerExecutor:
             out.append((oid.binary(), sobj.total_size()))
         for oid_b, value in donate_after:
             device_objects.note_return(self.core, oid_b, value, donate=True)
-        return out
+        return out, inline
 
-    def _store_dynamic_returns(self, spec, result) -> list:
+    def _store_dynamic_returns(self, spec, result) -> tuple:
         """Generator task (num_returns="dynamic"): store each yielded
         value at return index 1..N as it is produced, then store the
         ObjectRefGenerator at index 0 — consumers only ever observe a
@@ -437,34 +499,62 @@ class WorkerExecutor:
         except plasma.ObjectExistsError:
             pass
         out.append((gen_oid, gen_obj.total_size()))
-        return out
+        # Dynamic yields are reconstructable-by-rerun and indexable via
+        # the generator object: they keep the store path (no inline).
+        return out, {}
 
-    def _store_error_returns(self, spec, err: BaseException) -> list:
-        blob = serialization.serialize(err)
+    def _store_error_returns(self, spec, err: BaseException) -> tuple:
+        """Materialize ``err`` as the value of every return id. The
+        exception is serialized and framed ONCE: a sub-threshold error
+        ships in-band with every return id ALIASING the same blob (the
+        completion pickle memoizes the shared bytes object, so an
+        N-return failure costs one copy on the wire and in the GCS
+        table); an oversized error writes that one frame into the store
+        per id — the per-id cost is a memcpy, never a re-serialization."""
+        sobj = serialization.serialize(err)
+        ids = spec.return_ids()
         out = []
-        for oid in spec.return_ids():
-            try:
-                self.core.store.put_serialized(oid.binary(), blob)
-            except plasma.ObjectExistsError:
-                pass
-            out.append((oid.binary(), blob.total_size()))
-        return out
+        inline: Dict[bytes, bytes] = {}
+        blob = sobj.to_bytes()
+        if inline_objects.eligible(sobj, self._inline_max):
+            for oid in ids:
+                inline[oid.binary()] = blob
+                out.append((oid.binary(), len(blob)))
+            return out, inline
+        for oid in ids:
+            self.core._store_local(oid.binary(), blob)
+            out.append((oid.binary(), len(blob)))
+        return out, inline
+
+    _COMPLETION_BATCH = 64
 
     def _task_done(self, spec, status: str, objects: list,
-                   error: Optional[str] = None):
-        try:
-            self.nm.notify("task_done", {
-                "task_id": spec.task_id.binary(),
-                "status": status,
-                "objects": objects,
-                "error": error,
-            })
-        except protocol.ConnectionClosed:
-            os._exit(0)
+                   error: Optional[str] = None,
+                   inline: Optional[dict] = None):
+        """Buffer a classic-path completion for the NM; coalesced into
+        task_done_batch frames exactly like lease results coalesce into
+        lease_tasks_done — ship when the queue empties (a lone task
+        never waits on a flush window) or at _COMPLETION_BATCH."""
+        rec = {
+            "task_id": spec.task_id.binary(),
+            "status": status,
+            "objects": objects,
+            "error": error,
+        }
+        if inline:
+            rec["inline"] = inline
+        with self._completions_lock:
+            self._completions.append((None, rec))
+            n = len(self._completions)
+        with self._cv:
+            backlog = len(self._queue)
+        if backlog == 0 or n >= self._COMPLETION_BATCH:
+            self._flush_completions()
+        else:
+            self._flush_arm.set()
 
-    def _set_ctx(self, spec, actor_id: Optional[ActorID] = None):
-        from ray_tpu.util import tracing
-
+    def _set_ctx(self, spec, actor_id: Optional[ActorID] = None,
+                 tid_hex: Optional[str] = None):
         ctx = self.core.ctx
         ctx.task_id = spec.task_id
         ctx.job_id = spec.job_id
@@ -476,12 +566,14 @@ class WorkerExecutor:
         # Continue the caller's trace: tasks submitted from THIS task
         # become its children (reference: tracing_helper.py:318 context
         # re-attachment on the execution side).
-        tracing.activate(getattr(spec, "trace_ctx", None),
-                         spec.task_id.binary().hex())
+        self._tracing.activate(getattr(spec, "trace_ctx", None),
+                               tid_hex if tid_hex is not None
+                               else spec.task_id.binary().hex())
 
     def _execute_task(self, spec: TaskSpec):
-        self._current_task_id = spec.task_id.binary()
-        self._set_ctx(spec)
+        tid = spec.task_id.binary()
+        self._current_task_id = tid
+        self._set_ctx(spec, tid_hex=tid.hex())
         start = time.time()
         try:
             fn = self.core.fetch_function(spec.function_key)
@@ -489,17 +581,17 @@ class WorkerExecutor:
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
-            objects = self._store_returns(spec, result)
+            objects, inline = self._store_returns(spec, result)
             status, error = "ok", None
         except BaseException as e:
             err = exceptions.RayTaskError.from_exception(
                 spec.name or spec.function_key[:8], e)
-            objects = self._store_error_returns(spec, err)
+            objects, inline = self._store_error_returns(spec, err)
             status, error = "error", str(e)
         finally:
             self._current_task_id = None
             self._cancel_requested = None
-        self._task_done(spec, status, objects, error)
+        self._task_done(spec, status, objects, error, inline)
         self._report_event(spec.task_id, spec.name, start, status,
                            kind="task")
 
@@ -508,8 +600,9 @@ class WorkerExecutor:
         to the caller in a batched "lease_tasks_done" notify (no
         node-manager/GCS round trip on the hot path; the caller
         batch-reports completions to the GCS for locations + lineage)."""
-        self._current_task_id = spec.task_id.binary()
-        self._set_ctx(spec)
+        tid = spec.task_id.binary()
+        self._current_task_id = tid
+        self._set_ctx(spec, tid_hex=tid.hex())
         start = time.time()
         try:
             fn = self.core.fetch_function(spec.function_key)
@@ -517,41 +610,95 @@ class WorkerExecutor:
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
-            objects = self._store_returns(spec, result)
+            objects, inline = self._store_returns(spec, result)
             status, error = "ok", None
         except BaseException as e:
             err = exceptions.RayTaskError.from_exception(
                 spec.name or spec.function_key[:8], e)
-            objects = self._store_error_returns(spec, err)
+            objects, inline = self._store_error_returns(spec, err)
             status, error = "error", str(e)
         finally:
             self._current_task_id = None
             self._cancel_requested = None
-        self._queue_lease_result(conn, {
-            "task_id": spec.task_id.binary(), "status": status,
-            "objects": objects, "error": error, "node_id": self.node_id})
+        rec = {
+            "task_id": tid, "status": status,
+            "objects": objects, "error": error, "node_id": self.node_id}
+        if inline:
+            rec["inline"] = inline
+        self._queue_lease_result(conn, rec)
         with self._cv:
             backlog = len(self._queue)
-        if backlog == 0 or len(self._lease_results) >= 64:
-            self._flush_lease_results()
+        if backlog == 0 or len(self._completions) >= self._COMPLETION_BATCH:
+            self._flush_completions()
+        else:
+            self._flush_arm.set()
         self._report_event(spec.task_id, spec.name, start, status,
                            kind="task")
 
     def _queue_lease_result(self, conn, result: dict):
-        with self._lease_results_lock:
-            self._lease_results.append((conn, result))
+        with self._completions_lock:
+            self._completions.append((conn, result))
 
-    def _flush_lease_results(self):
-        with self._lease_results_lock:
-            pending, self._lease_results = self._lease_results, []
+    def _flush_completions(self):
+        """Ship every buffered completion: lease results batch per
+        holder conn (lease_tasks_done), classic-path records coalesce
+        into ONE task_done_batch frame of (task_id, pickled-record)
+        pairs — the task ids ride OUTSIDE the blobs so the NM can do
+        its worker bookkeeping and relay the blobs to the GCS without
+        unpickling them (mirroring submit_task_batch)."""
+        with self._completions_lock:
+            pending, self._completions = self._completions, []
+        if not pending:
+            return
+        nm_records: list = []
         by_conn: Dict[Any, list] = {}
+        inline_n = 0
         for conn, result in pending:
-            by_conn.setdefault(conn, []).append(result)
+            inline_n += len(result.get("inline") or ())
+            if conn is None:
+                nm_records.append(result)
+            else:
+                by_conn.setdefault(conn, []).append(result)
+        if inline_n:
+            try:
+                _worker_metrics()[0].inc(inline_n)
+            except Exception:
+                pass
         for conn, results in by_conn.items():
             try:
                 conn.notify("lease_tasks_done", {"results": results})
             except protocol.ConnectionClosed:
                 pass  # caller gone; its GCS-side cleanup owns the fallout
+        if not nm_records:
+            return
+        try:
+            if self._batch_done:
+                self.nm.notify("task_done_batch", [
+                    (r["task_id"], pickle.dumps(r, protocol=5))
+                    for r in nm_records])
+            else:
+                for r in nm_records:
+                    self.nm.notify("task_done", r)
+        except protocol.ConnectionClosed:
+            os._exit(0)
+
+    def _completion_flush_loop(self):
+        """Slack-bounded completion flusher: armed when a completion is
+        buffered behind a non-empty task queue, it flushes ``slack``
+        seconds later regardless of what the main loop is executing —
+        the bound on how long a finished result can wait behind a slow
+        successor task. Fast bursts coalesce into one frame inside the
+        slack window instead of flushing one frame per task."""
+        while not self._flush_stop.is_set():
+            # raylint: disable-next=unbounded-wait (armed-event park;
+            # stop() sets _flush_stop then _flush_arm to unpark it)
+            self._flush_arm.wait()
+            if self._flush_stop.is_set():
+                return
+            self._flush_arm.clear()
+            self._flush_stop.wait(self._flush_slack)
+            if self._completions:
+                self._flush_completions()
 
     def _create_actor(self, spec: ActorCreationSpec):
         self.actor_spec = spec
@@ -653,6 +800,7 @@ class WorkerExecutor:
 
     def _delayed_exit(self):
         time.sleep(0.1)
+        self._flush_completions()
         self.nm.flush()
         os._exit(0)
 
@@ -705,7 +853,7 @@ class WorkerExecutor:
             result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
-            objects = self._store_returns(spec, result)
+            objects, inline = self._store_returns(spec, result)
             status, error = "ok", None
         except SystemExit:
             # ray_tpu.actor.exit_actor(): graceful, expected termination.
@@ -714,18 +862,18 @@ class WorkerExecutor:
                     "actor_id": self.actor_spec.actor_id.binary()})
             except protocol.ConnectionClosed:
                 pass
-            objects = self._store_returns(spec, None)
+            objects, inline = self._store_returns(spec, None)
             status, error = "ok", None
             exit_after = True
         except BaseException as e:
             err = exceptions.RayTaskError.from_exception(
                 f"{spec.method_name}", e)
-            objects = self._store_error_returns(spec, err)
+            objects, inline = self._store_error_returns(spec, err)
             status, error = "error", str(e)
         finally:
             self._current_task_id = None
             self._cancel_requested = None
-        self._task_done(spec, status, objects, error)
+        self._task_done(spec, status, objects, error, inline)
         self._report_event(spec.task_id, spec.method_name, start, status,
                            kind="actor_task")
         if exit_after:
@@ -748,14 +896,14 @@ class WorkerExecutor:
                 result = method(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     result = await result
-                objects = self._store_returns(spec, result)
+                objects, inline = self._store_returns(spec, result)
                 status, error = "ok", None
             except BaseException as e:
                 err = exceptions.RayTaskError.from_exception(
                     spec.method_name, e)
-                objects = self._store_error_returns(spec, err)
+                objects, inline = self._store_error_returns(spec, err)
                 status, error = "error", str(e)
-            self._task_done(spec, status, objects, error)
+            self._task_done(spec, status, objects, error, inline)
             self._report_event(spec.task_id, spec.method_name, start, status,
                                kind="actor_task")
 
@@ -794,6 +942,14 @@ class WorkerExecutor:
 
     def _event_flush_loop(self):
         while not self._event_stop.wait(0.2):
+            # Safety-net completion flush: queue-empty/size triggers
+            # cover the main loop, but actor thread-pool / asyncio
+            # completions can land while the main queue is busy.
+            if self._completions:
+                try:
+                    self._flush_completions()
+                except Exception:
+                    pass
             self._flush_events()
 
     def _flush_events(self):
@@ -832,6 +988,21 @@ def main():
     store_path = os.environ["RAY_TPU_STORE_PATH"]
     # raylint: disable-next=config-knob-drift (bootstrap identity)
     node_id = os.environ["RAY_TPU_NODE_ID"]
+    # Non-default config of the spawning node manager (JSON diff; the
+    # analog of serve.start shipping _system_config to worker actors):
+    # without it, knobs set programmatically on the driver — inline-
+    # return thresholds, A/B toggles — would silently default here,
+    # because zygote-forked workers inherit the ZYGOTE's env (which
+    # deliberately strips RAY_TPU_*), not the driver's.
+    # raylint: disable-next=config-knob-drift (bootstrap identity:
+    # applied through the typed registry, not a raw knob read)
+    cfg_diff = os.environ.get("RAY_TPU_SYSTEM_CONFIG")
+    if cfg_diff:
+        try:
+            config.apply_system_config(cfg_diff)
+        except Exception:
+            print("worker: malformed RAY_TPU_SYSTEM_CONFIG ignored",
+                  file=sys.stderr, flush=True)
 
     try:
         core = CoreWorker(
@@ -855,6 +1026,16 @@ def main():
         executor.run()
     finally:
         executor._event_stop.set()
+        executor._flush_stop.set()
+        executor._flush_arm.set()   # unpark the slack flusher to exit
+        # Completions first: buffered task_done_batch records must reach
+        # the NM before the conns die with this process (at-least-once —
+        # a record lost here is re-run via the NM's worker-death report
+        # and deduped by the GCS's idempotent location/put handling).
+        try:
+            executor._flush_completions()
+        except Exception:
+            pass
         executor._flush_events()
         core.disconnect()
 

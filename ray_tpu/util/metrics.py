@@ -238,6 +238,27 @@ def report_to_gcs() -> bool:
         return False
 
 
+def lazy_metrics(factory):
+    """Zero-arg accessor for a lazily-built metric family: the first
+    call runs ``factory()`` (which registers the Counter/Gauge/
+    Histogram objects), starts the background reporter, and caches the
+    result — so importing a module that DEFINES metrics never spins
+    the reporter thread. Thread-safe (double-checked)."""
+    lock = threading.Lock()
+    cache: List = []
+
+    def get():
+        if not cache:
+            with lock:
+                if not cache:
+                    built = factory()
+                    start_reporter()
+                    cache.append(built)
+        return cache[0]
+
+    return get
+
+
 # Reporter lifecycle: ONE daemon thread per process, stoppable. Every
 # subsystem that wants its metrics shipped (lease manager, gang
 # supervisor, serve replicas) calls start_reporter(); only the first

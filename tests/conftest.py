@@ -59,6 +59,10 @@ LOCKDEP_MODULES = {
     # writer lock, and the NM's ring-drain thread to the lease/NM/GCS
     # lock graph — witness the new blocking edges where they are driven.
     "test_submit_fastpath",
+    # The result-return fast path adds the inline table/cache leaf
+    # locks, the worker's completion-buffer lock, and the GCS's batched
+    # completion handler to that same graph — witness it end to end.
+    "test_inline_returns",
 }
 
 
