@@ -74,6 +74,12 @@ TOGGLES = {
                        "the delivered blob — vs a store put per return "
                        "and a store read per get (the pre-SCALE_r09 "
                        "result-return baseline)"),
+    "completion_ring": ("RAY_TPU_COMPLETION_RING_ENABLED",
+                        "shared-memory completion ring from the "
+                        "same-node node manager — task_done_batch "
+                        "blobs absorb into the driver via memcpy + "
+                        "doorbell instead of waiting on the GCS relay "
+                        "— vs the socket/GCS-only delivery path"),
 }
 
 

@@ -30,7 +30,13 @@ Prints one JSON line per metric. Run: python benchmarks/scale_bench.py
 [N_tasks] [K_actors] [--gcs-out-of-process {0,1}]
 [--profile-submit OUT.speedscope.json] [--drivers N]
 [--submit-fastpath {0,1}] [--inline-returns {0,1}]
+[--completion-fastpath {0,1}]
 [--profile-turnaround OUT.speedscope.json].
+
+``--completion-fastpath`` pins all THREE driver-side completion
+ingestion stages (RAY_TPU_COMPLETION_{ABSORB,RING,STEAL}_ENABLED) for
+this run and every child driver: the SCALE_r10 A/B is two runs of this
+script, 1 vs 0, same box.
 
 ``--inline-returns`` pins BOTH result-return fast-path stages
 (RAY_TPU_WORKER_INLINE_RETURNS_ENABLED /
@@ -38,7 +44,7 @@ RAY_TPU_TASK_DONE_BATCH_ENABLED) for this run and every child driver:
 the SCALE_r09 A/B is two runs of this script, 1 vs 0, same box, per
 microbench_compare conventions.
 
-``--profile-turnaround`` samples the WORKER side (cluster-wide profile
+``--profile-turnaround`` samples the WORKER + DRIVER sides (cluster-wide profile
 fan-out) for the duration of the worker-turnaround phase and writes
 the merged speedscope document (+ .folded sibling): the worker-side
 evidence artifact the ISSUE 14 executor-loop shedding starts from.
@@ -155,6 +161,7 @@ def main():
     profile_turnaround = None
     submit_fastpath = None
     inline_returns = None
+    completion_fastpath = None
     n_drivers = 3
     i = 0
     while i < len(argv):
@@ -183,6 +190,14 @@ def main():
                 i += 1
                 v = argv[i]
             inline_returns = v.strip().lower() not in (
+                "0", "false", "off") if v else True
+        elif a.startswith("--completion-fastpath"):
+            _, eq, v = a.partition("=")
+            if not eq and i + 1 < len(argv) and argv[i + 1].lower() in (
+                    "0", "1", "true", "false", "on", "off"):
+                i += 1
+                v = argv[i]
+            completion_fastpath = v.strip().lower() not in (
                 "0", "false", "off") if v else True
         elif a.startswith("--profile-turnaround"):
             _, eq, v = a.partition("=")
@@ -219,6 +234,12 @@ def main():
     if inline_returns is not None:
         for k in _RETURN_KNOBS:
             os.environ["RAY_TPU_" + k] = "1" if inline_returns else "0"
+    _COMPLETION_KNOBS = ("COMPLETION_ABSORB_ENABLED",
+                         "COMPLETION_RING_ENABLED",
+                         "COMPLETION_STEAL_ENABLED")
+    if completion_fastpath is not None:
+        for k in _COMPLETION_KNOBS:
+            os.environ["RAY_TPU_" + k] = "1" if completion_fastpath else "0"
 
     import ray_tpu
     from ray_tpu._private.config import config as _cfg
@@ -251,6 +272,14 @@ def main():
         "toggle": "--inline-returns / RAY_TPU_WORKER_INLINE_RETURNS_"
                   "ENABLED + RAY_TPU_TASK_DONE_BATCH_ENABLED"}),
         flush=True)
+    print(json.dumps({
+        "metric": "completion_fastpath",
+        "value": {
+            "absorb": bool(_cfg.completion_absorb_enabled),
+            "ring": bool(_cfg.completion_ring_enabled),
+            "steal": bool(_cfg.completion_steal_enabled)},
+        "toggle": "--completion-fastpath / RAY_TPU_COMPLETION_"
+                  "{ABSORB,RING,STEAL}_ENABLED"}), flush=True)
     from ray_tpu._private import worker as worker_mod
     try:
         @ray_tpu.remote(resources={"impossible": 1})
@@ -419,8 +448,12 @@ def main():
         if prof_thread is not None:
             prof_thread.join(timeout=30)
             profiles = prof_result.get("profiles") or []
+            # Workers carry the execute->complete half; the driver
+            # carries the ingest half (conn thread vs absorb executor
+            # vs refill-send) — the SCALE_r10 completion-ingestion
+            # profile needs both sides of the turnaround.
             workers_only = [p for p in profiles
-                            if p.get("kind") == "worker"]
+                            if p.get("kind") in ("worker", "driver")]
             if workers_only:
                 from ray_tpu._private.profiler import (
                     folded_lines, speedscope_document)

@@ -324,6 +324,45 @@ _d("task_done_batch_enabled", True,
    "instead of once per task. Off = one task_done notify per task "
    "(the pre-SCALE_r09 baseline).")
 
+# --- driver completion ingestion fast path (absorb split / shm ring) -------
+_d("completion_absorb_enabled", True,
+   "Split completion absorption from sending on the driver (SCALE_r10 "
+   "stage 1): leased workers ship lease_tasks_done_b frames of "
+   "pre-pickled per-record blobs, the lease conn thread's only job "
+   "becomes parking the raw frame into a lock-free ingest queue, and "
+   "a dedicated rtpu-completion-absorb executor does the unpickle / "
+   "InlineCache insert / waiter wakeup / decref accounting — with the "
+   "pipeline refill-send handed to the lease executor so a slow "
+   "absorb can never stall top-up. Off = workers send the classic "
+   "lease_tasks_done dict frame and the conn thread absorbs inline "
+   "(the pre-SCALE_r10 baseline; part of --completion-fastpath in "
+   "benchmarks/scale_bench.py).")
+_d("completion_ring_enabled", True,
+   "Shared-memory completion ring from the same-node node manager "
+   "(SCALE_r10 stage 2, the submit ring's return-path twin): the NM "
+   "relays classic-path task_done_batch record blobs into a "
+   "per-driver SPSC ring in a mmapped session file — without "
+   "unpickling them and WITHOUT skipping the authoritative GCS relay "
+   "— and the driver's consumer thread absorbs them locally (inline "
+   "cache insert + pending-returns retire), so wave get()/wait() "
+   "resolves without a GCS round trip. Ring-full skips the append "
+   "(the GCS copy delivers; driver_completion_ring_full_total counts "
+   "it); driver death is detected by consumer-heartbeat staleness. "
+   "x86-64 only, like the submit ring. The 'completion_ring' toggle "
+   "in benchmarks/microbench_compare.py.")
+_d("completion_ring_bytes", 4 * 1024 * 1024,
+   "Data capacity of the per-driver completion ring. At ~300 bytes "
+   "per small-return completion record the default holds ~13k "
+   "undrained completions before appends spill to the GCS-only path.")
+_d("completion_steal_enabled", True,
+   "Parallel wave collection (SCALE_r10 stage 3): a get()/wait() "
+   "caller about to block drains the completion ingest queue on its "
+   "own thread (work-stealing the absorb step from the absorb "
+   "executor), so collecting a wave of refs scales with the threads "
+   "asking instead of serializing behind one absorb thread. Off = "
+   "callers park on the completion event and only the absorb "
+   "executor drains.")
+
 # --- direct task transport (worker leases) ---------------------------------
 _d("lease_enabled", True,
    "Stream same-shape tasks directly to leased workers, bypassing the "

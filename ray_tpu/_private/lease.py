@@ -32,6 +32,7 @@ from __future__ import annotations
 import collections
 import concurrent.futures
 import os
+import pickle
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -79,6 +80,35 @@ def _submit_metrics_get():
                 )
                 metrics.start_reporter()
     return _submit_metrics
+
+
+# Driver completion-ingestion metrics (SCALE_r10 absorb split): lazy
+# like the submit-pipeline family above.
+_completion_metrics = None
+_completion_metrics_lock = threading.Lock()
+
+
+def _completion_metrics_get():
+    global _completion_metrics
+    if _completion_metrics is None:
+        with _completion_metrics_lock:
+            if _completion_metrics is None:
+                from ray_tpu.util import metrics
+
+                _completion_metrics = (
+                    metrics.Gauge(
+                        "driver_completion_absorb_depth",
+                        "Completion frames parked in the driver's ingest "
+                        "queue awaiting absorption (sampled by the absorb "
+                        "drain)"),
+                    metrics.Histogram(
+                        "driver_completion_batch_size",
+                        "Completion records per driver-ingested lease "
+                        "completion frame",
+                        boundaries=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512]),
+                )
+                metrics.start_reporter()
+    return _completion_metrics
 
 
 def _grant_latency_hist():
@@ -207,6 +237,17 @@ class LeaseManager:
                     self._local_nm_addr = addr
             except Exception:
                 pass   # no NM reachable: GCS-brokered grants only
+        # Completion ingestion fast path (SCALE_r10 stage 1): the lease
+        # conn thread parks raw lease_tasks_done_b frames here (lock-free
+        # deque) and the absorb executor — or a get()/wait() caller
+        # work-stealing via steal_absorb (stage 3) — does the unpickle /
+        # inline insert / wakeup / decref accounting.
+        self._ingest: collections.deque = collections.deque()
+        self._absorb_enabled = bool(config.completion_absorb_enabled)
+        self._steal = bool(config.completion_steal_enabled)
+        self._absorb_exec = (concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rtpu-completion-absorb")
+            if self._absorb_enabled else None)
         # Lease acquisition dials node managers / workers (blocking), so it
         # runs here — never on a conn's serve thread.
         self._exec = concurrent.futures.ThreadPoolExecutor(
@@ -700,7 +741,94 @@ class LeaseManager:
                 lse = holder.get("lease")
                 if lse is not None:
                     self._on_tasks_done(lse, payload["results"])
+            elif mtype == protocol.LEASE_TASKS_DONE_B:
+                lse = holder.get("lease")
+                if lse is None:
+                    return
+                if self._absorb_exec is not None:
+                    # The conn thread's whole job: park the raw blob
+                    # list and poke the absorb executor. Unpickle,
+                    # inline insert, waiter wakeup, refill computation
+                    # and decrefs all happen off this thread.
+                    self._ingest.append((lse, payload))
+                    self._absorb_submit()
+                else:
+                    # Knob drift (worker ships blobs, driver absorb
+                    # off): absorb inline — always correct, just the
+                    # pre-split cost profile.
+                    self._absorb_frame(lse, payload)
         return on_msg
+
+    # ------------------------------------------------- completion absorb
+
+    def _absorb_submit(self):
+        try:
+            self._absorb_exec.submit(self._drain_ingest)
+        except RuntimeError:   # executor shut down: manager closing
+            pass
+
+    def _drain_ingest(self):
+        while True:
+            try:
+                lease, blobs = self._ingest.popleft()
+            except IndexError:
+                break
+            self._absorb_frame(lease, blobs)
+        try:
+            _completion_metrics_get()[0].set(len(self._ingest))
+        except Exception:
+            pass
+
+    def _absorb_frame(self, lease: _Lease, blobs: List[bytes]):
+        try:
+            results = [pickle.loads(b) for b in blobs]
+            self._on_tasks_done(lease, results, defer_send=True)
+        except BaseException as e:
+            self._absorb_failed(lease, e)
+
+    def _absorb_failed(self, lease: _Lease, e: BaseException):
+        """Absorption died on a frame (corrupt blob, absorb bug): a
+        silent drop would hang every getter parked on this lease's
+        returns. Fail them all with a TYPED error instead — the worker
+        may have executed the tasks, but their results can no longer be
+        attributed, and the lease's accounting is unrecoverable."""
+        from ray_tpu import exceptions as exc
+
+        err = exc.CompletionAbsorbError(
+            f"completion absorb failed: {type(e).__name__}: {e}")
+        with self._lock:
+            specs = list(lease.pending.values())
+            lease.pending.clear()
+            for spec in specs:
+                lease.inflight -= 1
+                self._task_lease.pop(spec.task_id.binary(), None)
+                for rid in spec.return_ids():
+                    ent = self._inflight.get(rid.binary())
+                    if ent is not None:
+                        ent["error"] = err
+                        ent["ev"].set()
+        for spec in specs:
+            self._decref_deps(spec)
+        self._exec_submit(self._drop_lease, lease)
+
+    def steal_enabled(self) -> bool:
+        return self._steal
+
+    def steal_absorb(self) -> bool:
+        """Stage 3 (parallel wave collection): a caller about to block
+        in get()/wait() absorbs one parked completion frame on ITS OWN
+        thread instead of idling behind the absorb executor. Returns
+        False when the queue is empty (or stealing is off) — the caller
+        then parks for real. Absorption is thread-safe: accounting runs
+        under the manager lock, the inline cache lock is a leaf."""
+        if not self._steal:
+            return False
+        try:
+            lease, blobs = self._ingest.popleft()
+        except IndexError:
+            return False
+        self._absorb_frame(lease, blobs)
+        return True
 
     def _direct_address(self, grant: Dict[str, Any]) -> str:
         """Pick the cheapest transport to the leased worker: its AF_UNIX
@@ -877,9 +1005,18 @@ class LeaseManager:
 
     # ------------------------------------------------------- completion
 
-    def _on_tasks_done(self, lease: _Lease, results: List[dict]):
-        """Batched completion notify from the leased worker (runs on the
-        lease conn's serve thread — wake getters, refill the pipeline)."""
+    def _on_tasks_done(self, lease: _Lease, results: List[dict],
+                       defer_send: bool = False):
+        """Batched completion notify from the leased worker: wake
+        getters, refill the pipeline. Runs on the lease conn's serve
+        thread on the classic path; with the absorb split it runs on
+        the absorb executor (or a stealing caller thread) and hands the
+        refill-send to the lease executor (defer_send) so a slow absorb
+        can never stall pipeline top-up."""
+        try:
+            _completion_metrics_get()[1].observe(len(results))
+        except Exception:
+            pass
         done_specs = []
         drained: List[Any] = []
         with self._lock:
@@ -951,7 +1088,10 @@ class LeaseManager:
             if deps:
                 refs.decref_many(deps)
         if drained:
-            self._send(lease, drained)
+            if defer_send:
+                self._exec_submit(self._send, lease, drained)
+            else:
+                self._send(lease, drained)
         if drain_done:
             # Revocation drain finished: NOW surrender the worker.
             self._exec_submit(self._drop_lease, lease)
@@ -1342,3 +1482,8 @@ class LeaseManager:
             except Exception:
                 pass
         self._exec.shutdown(wait=False)
+        if self._absorb_exec is not None:
+            # Parked frames die with the driver: every inflight event
+            # was already set above, so nothing can hang on them.
+            self._ingest.clear()
+            self._absorb_exec.shutdown(wait=False)

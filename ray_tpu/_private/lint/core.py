@@ -53,6 +53,11 @@ CONTROL_PLANE = (
     # a blocking call under its lock or an unbounded park here stalls
     # the submit pipeline of a whole client.
     "ray_tpu/_private/submit_ring.py",
+    # The shm completion ring: its consumer loop runs inside every
+    # driver and its producer is called from the NM's task_done path
+    # under a per-ring lock — an unbounded park or a blocking call
+    # under that lock stalls completion delivery for a whole node.
+    "ray_tpu/_private/completion_ring.py",
     # The inline-object tables back every get()/deserialize_args and
     # sit under the GCS object shard and the lease completion handler —
     # a blocking call under their leaf locks would invert the whole

@@ -48,6 +48,30 @@ from ray_tpu.object_store import plasma
 
 logger = logging.getLogger("ray_tpu.node")
 
+
+# Lazy: register the ring-full counter (and spin the metrics reporter)
+# only once a completion ring actually declines an append.
+_comp_ring_metrics = None
+_comp_ring_metrics_lock = threading.Lock()
+
+
+def _comp_ring_full_counter():
+    global _comp_ring_metrics
+    if _comp_ring_metrics is None:
+        with _comp_ring_metrics_lock:
+            if _comp_ring_metrics is None:
+                from ray_tpu.util import metrics
+
+                _comp_ring_metrics = metrics.Counter(
+                    "driver_completion_ring_full_total",
+                    "Completion records the NM could not append to a "
+                    "same-node driver's shm completion ring (ring "
+                    "full); the unconditional GCS relay still delivers "
+                    "them")
+                metrics.start_reporter()
+    return _comp_ring_metrics
+
+
 IDLE = "idle"
 BUSY = "busy"
 STARTING = "starting"
@@ -282,6 +306,15 @@ class NodeManager:
         # conn -> [{reader, thread, stop}]; cleaned up on disconnect.
         self._submit_rings: Dict[Any, List[dict]] = {}
 
+        # Shared-memory completion rings (SCALE_r10 stage 2, the submit
+        # ring's return-path twin): per-driver SPSC rings this NM
+        # APPENDS worker task_done_batch record blobs into (never
+        # unpickling them) so the same-node driver learns completions
+        # with a memcpy + doorbell; the GCS relay stays unconditional
+        # and authoritative. conn -> [{producer, client_id}]; cleaned
+        # up on disconnect or consumer-heartbeat staleness.
+        self._completion_rings: Dict[Any, List[dict]] = {}
+
         # Server for workers, remote pullers, and actor-task callers.
         self.server = protocol.Server(self._handle_server, name=f"nm-{node_name}")
         self.server.on_disconnect = self._on_server_disconnect
@@ -412,6 +445,18 @@ class NodeManager:
         if heartbeater is not None:
             heartbeater.join(timeout=2)
         self._actor_exec.shutdown(wait=False)
+        # Flag every completion-ring producer closed so still-draining
+        # drivers exit their consumer loops (never unlinks: the driver
+        # owns the files).
+        with self._lock:
+            comp_ents = [e for lst in self._completion_rings.values()
+                         for e in lst]
+            self._completion_rings.clear()
+        for ent in comp_ents:
+            try:
+                ent["producer"].close()
+            except Exception:
+                pass
         self.server.close()
         try:
             self.gcs.close()
@@ -1093,8 +1138,17 @@ class NodeManager:
                 dead_grants = [lid for lid, g in self._local_grants.items()
                                if g["conn"] is conn]
                 rings = self._submit_rings.pop(conn, [])
+                comp_rings = self._completion_rings.pop(conn, [])
             for ent in rings:
                 ent["stop"] = True   # drain thread exits after a final pass
+            for ent in comp_rings:
+                # Producer close flags the ring so a still-draining
+                # consumer exits; never unlinks (the driver owns the
+                # file and removes it on disconnect).
+                try:
+                    ent["producer"].close()
+                except Exception:
+                    pass
             for lid in dead_grants:
                 self._release_local_grant(lid)
             for w in leased:
@@ -2042,6 +2096,8 @@ class NodeManager:
                 self._on_return_local_lease(conn, payload)
             elif mtype == "register_submit_ring":
                 self._on_register_submit_ring(conn, payload, msg_id)
+            elif mtype == protocol.REGISTER_COMPLETION_RING:
+                self._on_register_completion_ring(conn, payload, msg_id)
             elif mtype == protocol.SCHEDULER_STATS:
                 conn.reply(msg_id, self._scheduler_stats())
             elif mtype == "abandon_lease":
@@ -2601,6 +2657,72 @@ class NodeManager:
             except Exception:
                 pass
 
+    # Matches lease._RING_STALE_S rationale: comfortably above any
+    # bounded stall of the driver's consumer thread, so a healthy-but-
+    # busy driver can never look dead.
+    _COMP_RING_STALE_S = 5.0
+
+    def _on_register_completion_ring(self, conn, p: dict, msg_id):
+        """A same-node driver created a completion ring file and asks
+        us to produce into it. The driver owns the file and the
+        doorbell; we just map it and append."""
+        from ray_tpu._private import completion_ring
+
+        if self._shutdown:
+            conn.reply(msg_id, False)
+            return
+        try:
+            producer = completion_ring.RingProducer(p["path"])
+            producer.connect_bell()
+        except Exception as e:
+            logger.warning("completion ring %s rejected: %s",
+                           p.get("path"), e)
+            conn.reply(msg_id, False)
+            return
+        ent = {"producer": producer, "client_id": p.get("client_id")}
+        with self._lock:
+            self._completion_rings.setdefault(conn, []).append(ent)
+        conn.reply(msg_id, True)
+
+    def _relay_completion_rings(self, blobs: List[bytes]):
+        """Append worker completion-record blobs to every registered
+        same-node driver ring, WITHOUT unpickling them. Records carry
+        no destination, so this is a broadcast — safe because driver-
+        side absorption is redelivery- and foreign-record-idempotent
+        (an LRU-bounded inline insert, a no-op pending pop). Ring-full
+        skips the rest of the batch for that ring: the unconditional
+        GCS relay is the authoritative copy, the ring only a fast-path
+        hint. A full ring whose consumer heartbeat is stale means the
+        driver died without its conn closing — tear the ring down."""
+        with self._lock:
+            ents = [(conn, e) for conn, lst in self._completion_rings.items()
+                    for e in lst]
+        if not ents:
+            return
+        dead = []
+        for conn, ent in ents:
+            producer = ent["producer"]
+            for i, blob in enumerate(blobs):
+                if not producer.append(blob):
+                    try:
+                        _comp_ring_full_counter().inc(len(blobs) - i)
+                    except Exception:
+                        pass
+                    if producer.consumer_stale(self._COMP_RING_STALE_S):
+                        dead.append((conn, ent))
+                    break
+        for conn, ent in dead:
+            try:
+                ent["producer"].close()
+            except Exception:
+                pass
+            with self._lock:
+                lst = self._completion_rings.get(conn)
+                if lst is not None and ent in lst:
+                    lst.remove(ent)
+                    if not lst:
+                        self._completion_rings.pop(conn, None)
+
     def _on_revoke_local_lease(self, p):
         """GCS fairness signal: classic-queue work competing with
         locally-held resources can't place anywhere. Decline overlapping
@@ -2761,10 +2883,20 @@ class NodeManager:
                 held = self._res_held_tasks.pop(tid, None)
                 if held:
                     self._local_avail.release(held)
+        blobs = [b for _tid, b in payload]
+        # Same-node driver fast path FIRST (SCALE_r10 stage 2): a
+        # memcpy into each registered completion ring, still without
+        # unpickling. The GCS relay below stays unconditional — it is
+        # the authoritative copy; the ring only shortcuts the driver's
+        # next get()/wait().
+        if self._completion_rings:
+            try:
+                self._relay_completion_rings(blobs)
+            except Exception:
+                pass
         try:
             self.gcs.notify("task_done_batch", {
-                "node_id": self.node_id,
-                "blobs": [b for _tid, b in payload]})
+                "node_id": self.node_id, "blobs": blobs})
         except Exception:
             pass
         self._dispatch_queued()

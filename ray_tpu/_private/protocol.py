@@ -45,6 +45,13 @@ SCHEDULER_STATS = "scheduler_stats"            # any -> NM (request)
 # same-conn FIFO is the ordering guarantee the GCS relies on.
 REQUEST_CREATE_ACTOR = "request_create_actor"  # driver -> own NM (request)
 ACTOR_PLACED = "actor_placed"                  # NM -> GCS (notify)
+# Driver completion ingestion fast path (SCALE_r10): workers ship lease
+# completions as frames of pre-pickled per-record blobs (the completion
+# twin of lease_run_tasks_b) so the driver's conn thread only parks raw
+# bytes; drivers register a per-driver shm completion ring with their
+# own node manager (the submit ring's return-path twin).
+LEASE_TASKS_DONE_B = "lease_tasks_done_b"      # worker -> caller (notify)
+REGISTER_COMPLETION_RING = "register_completion_ring"  # driver -> NM (request)
 
 
 class ConnectionClosed(Exception):

@@ -22,6 +22,7 @@ import itertools
 import queue
 import hashlib
 import os
+import pickle
 import sys
 import threading
 import time
@@ -597,6 +598,22 @@ class CoreWorker:
             from ray_tpu._private.lease import LeaseManager
 
             self._lease_mgr = LeaseManager(self)
+        # Shm completion ring (SCALE_r10 stage 2): registered lazily
+        # with our own NM on the first submission (drivers only; see
+        # _maybe_register_completion_ring). 0=never tried,
+        # 1=registering, 2=active, 3=dead/unavailable. x86-64 only for
+        # the same store-store-ordering reason as the submit ring.
+        import platform as _platform
+
+        self._comp_ring = None
+        self._comp_ring_state = 0
+        self._comp_ring_thread: Optional[threading.Thread] = None
+        self._comp_ring_pause = False   # test seam: consumer idles
+        self._comp_ring_lock = threading.Lock()
+        self._comp_ring_enabled = (
+            role == "driver"
+            and bool(_cfg.completion_ring_enabled)
+            and _platform.machine() in ("x86_64", "AMD64"))
         # Workers get theirs lazily, on their first task submission:
         # LeaseManager construction costs a nodes() RPC + an NM pre-dial
         # + a flusher thread, and most actor/task workers never submit —
@@ -774,6 +791,20 @@ class CoreWorker:
             tracing_mod.flush_spans()
         except Exception:
             pass
+        # Completion-ring teardown BEFORE the conns close: stop the
+        # consumer thread (its finally unlinks the ring file and the
+        # doorbell — no mmap or socket may outlive the driver).
+        ring = self._comp_ring
+        if ring is not None:
+            self._comp_ring = None
+            ring.stopped = True
+            t = self._comp_ring_thread
+            if t is not None and t.is_alive():
+                t.join(timeout=2.0)
+            try:
+                ring.close()   # idempotent; covers a never-started loop
+            except Exception:
+                pass
         if self._lease_mgr is not None:
             try:
                 self._lease_mgr.close()
@@ -948,11 +979,40 @@ class CoreWorker:
             if ent is None:
                 rest.append(oid)
                 continue
-            t = None if deadline is None else max(0.0,
-                                                  deadline - time.time())
-            if not ent["ev"].wait(t):
-                raise exceptions.GetTimeoutError(
-                    "object not ready within timeout")
+            # Parallel wave collection (SCALE_r10 stage 3): instead of
+            # idling on the completion event while frames queue behind
+            # one absorb thread, absorb them HERE — one may be the very
+            # frame carrying this oid. Frames can land at ANY moment
+            # while we block, so the steal interleaves with bounded
+            # event waits (backing off to 50 ms) rather than running
+            # once up front and then parking unconditionally.
+            if lm.steal_enabled():
+                step = 0.002
+                while not ent["ev"].is_set():
+                    while not ent["ev"].is_set() and lm.steal_absorb():
+                        pass
+                    t = None if deadline is None \
+                        else deadline - time.time()
+                    if t is not None and t <= 0:
+                        raise exceptions.GetTimeoutError(
+                            "object not ready within timeout")
+                    if ent["ev"].wait(step if t is None
+                                      else min(step, t)):
+                        break
+                    step = min(step * 2, 0.05)
+            else:
+                t = None if deadline is None else max(0.0,
+                                                      deadline - time.time())
+                if not ent["ev"].wait(t):
+                    raise exceptions.GetTimeoutError(
+                        "object not ready within timeout")
+            err = ent.get("error")
+            if err is not None:
+                # Absorption died on this lease's completion frame: a
+                # typed failure at get(), never a silent hang (the
+                # worker may have run the task, but its result can no
+                # longer be attributed).
+                raise err
             info = ent.get("info")
             if info is None:          # task fell back to the scheduled path
                 rest.append(oid)
@@ -1253,26 +1313,54 @@ class CoreWorker:
         inflight = lm.inflight_map() if lm is not None else None
         pend = self._pending_returns
         ready_set = set()
-        for o in ids:
-            if o in self._inline:
-                ready_set.add(o)
-                continue
-            if inflight is not None and o in inflight:
-                # Completed-but-not-yet-flushed lease tasks are ready
-                # too; pending ones wait on their completion event —
-                # either way no per-ref ctypes store probe.
-                ent = lm.peek(o)
-                if ent is not None and ent["ev"].is_set() \
-                        and ent.get("info") is not None:
+
+        def scan(candidates):
+            # Probe in refs order and STOP once num_returns are
+            # satisfied: the result below only takes the first
+            # num_returns ready refs anyway, so probing past that
+            # point re-pays the peek/store cost every poll iteration
+            # for refs the caller already collected.
+            for o in candidates:
+                if len(ready_set) >= num_returns:
+                    break
+                if o in ready_set:
+                    continue
+                if o in self._inline:
                     ready_set.add(o)
-                continue
-            if o in pend:
-                # A still-pending return of our own submission: the
-                # GCS wait below is authoritative (and a stale window
-                # entry only costs that one batched round trip).
-                continue
-            if self.store.contains(o):
-                ready_set.add(o)
+                    continue
+                if inflight is not None and o in inflight:
+                    # Completed-but-not-yet-flushed lease tasks are
+                    # ready too; pending ones wait on their completion
+                    # event — either way no per-ref ctypes store
+                    # probe. An absorb-failed entry counts as ready:
+                    # the get() surfaces its typed error.
+                    ent = lm.peek(o)
+                    if ent is not None and (
+                            ent.get("error") is not None
+                            or (ent["ev"].is_set()
+                                and ent.get("info") is not None)):
+                        ready_set.add(o)
+                    continue
+                if o in pend:
+                    # A still-pending return of our own submission: the
+                    # GCS wait below is authoritative (and a stale
+                    # window entry only costs that one batched round
+                    # trip).
+                    continue
+                if self.store.contains(o):
+                    ready_set.add(o)
+
+        scan(ids)
+        if len(ready_set) < num_returns and lm is not None:
+            # Parallel wave collection (SCALE_r10 stage 3): about to
+            # block on the GCS, absorb any parked completion frames on
+            # THIS thread first — one of them may carry the refs this
+            # wait is polling for — then re-probe.
+            stole = False
+            while lm.steal_absorb():
+                stole = True
+            if stole:
+                scan(ids)
         if len(ready_set) < num_returns:
             # Server-parked wait (see _wait_missing): unbounded only
             # when the caller passed no timeout — wait()'s contract.
@@ -1527,6 +1615,10 @@ class CoreWorker:
         """Record just-minted return oids in the pending window (see
         _pending_returns in __init__). Amortized O(1): past the cap the
         oldest half is dropped in one pass — stale entries are safe."""
+        if self._comp_ring_state == 0 and self._comp_ring_enabled:
+            # First submission: register the shm completion ring with
+            # our NM (one int compare per call after that).
+            self._maybe_register_completion_ring()
         pend = self._pending_returns
         for b in oid_bytes_list:
             pend[b] = None
@@ -1541,6 +1633,106 @@ class CoreWorker:
                 return
             for b in stale:
                 pend.pop(b, None)
+
+    # ------------------------------------------ completion ring (driver)
+
+    def _maybe_register_completion_ring(self) -> None:
+        """One-shot CAS into the registering state (0 -> 1); the actual
+        registration (file create + NM round trip) runs on its own
+        short-lived thread, never on the submit hot path."""
+        with self._comp_ring_lock:
+            if self._comp_ring_state != 0 or self._closed:
+                return
+            self._comp_ring_state = 1
+        threading.Thread(target=self._register_completion_ring,
+                         daemon=True, name="rtpu-compring-reg").start()
+
+    def _register_completion_ring(self) -> None:
+        """Create the ring file (the driver owns it — role inversion vs
+        the submit ring) and ask our NM to produce into it."""
+        from ray_tpu._private import completion_ring
+
+        ring = None
+        try:
+            addr = self._own_nm_address()
+            if not addr:
+                raise RuntimeError("no local node manager")
+            path = os.path.join(
+                os.path.dirname(self.store_path),
+                f"comring_{os.getpid()}_{id(self) & 0xffffff:x}")
+            ring = completion_ring.RingConsumer(
+                path, int(config.completion_ring_bytes))
+            ok = self.nm_conn(addr).request(
+                protocol.REGISTER_COMPLETION_RING,
+                {"client_id": self.client_id, "path": path},
+                timeout=min(30.0, float(config.gcs_rpc_timeout_s)))
+            if not ok:
+                raise RuntimeError("node manager declined completion ring")
+            self._comp_ring = ring
+            self._comp_ring_state = 2
+            t = threading.Thread(target=self._completion_ring_loop,
+                                 daemon=True, name="rtpu-completion-ring")
+            self._comp_ring_thread = t
+            t.start()
+        except Exception:
+            self._comp_ring_state = 3
+            if ring is not None:
+                try:
+                    ring.close()
+                except Exception:
+                    pass
+
+    def _completion_ring_loop(self) -> None:
+        """Consumer thread: beat the heartbeat the NM watches for
+        driver liveness, absorb relayed completion records, park on the
+        doorbell when idle. The head commits only AFTER a batch is
+        absorbed — at-least-once, and safe because every absorb step is
+        redelivery-idempotent. Records a dead NM left behind are plain
+        shared memory: this loop keeps draining them (unconsumed-record
+        recovery is just finishing the drain)."""
+        ring = self._comp_ring
+        if ring is None:
+            return
+        try:
+            while not self._closed and not ring.stopped:
+                ring.beat()
+                if self._comp_ring_pause:   # test seam: stop consuming
+                    time.sleep(0.02)
+                    continue
+                blobs, new_head = ring.drain(256)
+                if blobs:
+                    for blob in blobs:
+                        try:
+                            self._absorb_completion_record(blob)
+                        except Exception:
+                            pass   # corrupt record: the GCS copy owns it
+                    ring.commit(new_head)
+                    continue
+                if ring.producer_closed():
+                    break
+                ring.park_wait()
+        finally:
+            try:
+                ring.close()
+            except Exception:
+                pass
+
+    def _absorb_completion_record(self, blob: bytes) -> None:
+        """Apply one NM-relayed completion record locally: inline blobs
+        land in the process cache, this driver's pending-returns window
+        entries retire (the produced objects are in OUR node's store —
+        the NM only relays same-node workers). Records are broadcast to
+        every same-node driver, so FOREIGN records must be — and are —
+        harmless: an LRU-bounded cache insert plus no-op pops."""
+        rec = pickle.loads(blob)
+        inline = rec.get("inline")
+        if inline:
+            cache = self._inline
+            for oid, b in inline.items():
+                cache.put(oid, b)
+        pend = self._pending_returns
+        for oid, _size in rec.get("objects") or ():
+            pend.pop(oid, None)
 
     def _wrap_return_refs(self, task_id: TaskID, num_returns,
                           spec) -> List[ObjectRef]:

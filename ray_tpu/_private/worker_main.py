@@ -90,6 +90,11 @@ class WorkerExecutor:
                             if bool(config.worker_inline_returns_enabled)
                             else 0)
         self._batch_done = bool(config.task_done_batch_enabled)
+        # SCALE_r10 stage 1: ship lease completions as frames of
+        # per-record pickle blobs (lease_tasks_done_b, the completion
+        # twin of lease_run_tasks_b) so the driver's conn thread only
+        # parks raw bytes and the absorb executor unpickles off-thread.
+        self._absorb_b = bool(config.completion_absorb_enabled)
         # Unified completion buffer: (conn_or_None, record) — None routes
         # to the NM as a task_done_batch frame (classic path), a conn is
         # a lease holder's direct connection (lease_tasks_done). One
@@ -666,7 +671,11 @@ class WorkerExecutor:
                 pass
         for conn, results in by_conn.items():
             try:
-                conn.notify("lease_tasks_done", {"results": results})
+                if self._absorb_b:
+                    conn.notify(protocol.LEASE_TASKS_DONE_B, [
+                        pickle.dumps(r, protocol=5) for r in results])
+                else:
+                    conn.notify("lease_tasks_done", {"results": results})
             except protocol.ConnectionClosed:
                 pass  # caller gone; its GCS-side cleanup owns the fallout
         if not nm_records:

@@ -89,6 +89,16 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     """``get`` timed out before the object became available."""
 
 
+class CompletionAbsorbError(RayTpuError):
+    """The driver's completion-absorb stage died on a frame.
+
+    The lease conn thread parks raw completion frames; a dedicated
+    absorb executor unpickles and applies them. If absorption raises
+    (corrupt frame, absorb-thread death), every return object the
+    frame's lease still had in flight gets this error attached and its
+    waiters woken — a typed failure at get(), never a silent hang."""
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Preparing a worker's runtime environment failed."""
 

@@ -63,6 +63,11 @@ LOCKDEP_MODULES = {
     # locks, the worker's completion-buffer lock, and the GCS's batched
     # completion handler to that same graph — witness it end to end.
     "test_inline_returns",
+    # The completion-ingestion fast path adds the absorb executor, the
+    # completion-ring producer lock (held on the NM's task_done path),
+    # and caller-thread steal-absorb to the lease/NM lock graph —
+    # witness the new edges where its tests drive them.
+    "test_completion_fastpath",
 }
 
 

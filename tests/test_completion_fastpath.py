@@ -1,0 +1,356 @@
+"""Driver-side completion ingestion fast path (ISSUE 16 / SCALE_r10):
+absorb split off the lease conn thread, the shm completion ring, and
+parallel (work-stealing) wave collection.
+
+The contract under test:
+
+* with ``completion_absorb_enabled`` the lease conn thread only parks
+  raw frames — a dedicated absorb executor unpickles and wakes waiters
+  — and results are IDENTICAL to the classic inline-absorb wire
+  (toggling the knob off restores the legacy ``lease_tasks_done``
+  format byte-for-byte);
+* NM-relayed completion-ring records land in the driver's inline cache
+  and retire pending-return window entries; a full ring is a COUNTED
+  no-op (the unconditional GCS relay still delivers), and the consumer
+  catches up after the stall;
+* records a dead NM left behind are plain shared memory: the driver
+  finishes draining them — no stranded record, and redelivery is
+  idempotent (no double-deliver);
+* driver shutdown unlinks the ring file and its doorbell socket — no
+  leaked mmap for the NM to produce into;
+* a dying absorb stage surfaces as a typed ``CompletionAbsorbError``
+  at ``get()``, never a silent hang;
+* ``get()``/``wait()`` steal parked frames onto the caller thread when
+  they would otherwise block, so a stalled absorb executor cannot
+  stall collection.
+"""
+
+import os
+import pickle
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import node_manager as nm_mod
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.exceptions import CompletionAbsorbError
+
+
+def _cluster(**system_config):
+    return ray_tpu.init(num_cpus=2,
+                        object_store_memory=128 * 1024 * 1024,
+                        _system_config=system_config or None)
+
+
+@pytest.fixture
+def ray_cluster():
+    ctx = _cluster()
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _worker():
+    return worker_mod.global_worker()
+
+
+def _nm():
+    return worker_mod._global_cluster.nm
+
+
+def _wait_for(pred, timeout=15, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if pred():
+                return
+        except Exception:
+            pass
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _record_blob(oid: bytes, value_blob: bytes) -> bytes:
+    """A completion record exactly as worker_main pickles them into
+    task_done_batch frames (the NM relays these blobs verbatim)."""
+    return pickle.dumps({
+        "task_id": b"\x01" * 24,
+        "status": "ok",
+        "objects": [(oid, len(value_blob))],
+        "error": None,
+        "node_id": "test-node",
+        "inline": {oid: value_blob},
+    }, protocol=5)
+
+
+def _activate_ring(w):
+    """Run one task (registration triggers off _note_pending_returns)
+    and wait until the driver's consumer loop AND the NM's producer
+    are both live."""
+
+    @ray_tpu.remote
+    def _poke():
+        return 0
+
+    assert ray_tpu.get(_poke.remote()) == 0
+    _wait_for(lambda: w._comp_ring_state in (2, 3), msg="ring registration")
+    assert w._comp_ring_state == 2, "ring registration failed"
+    _wait_for(lambda: any(_nm()._completion_rings.values()),
+              msg="NM producer registration")
+
+
+# --------------------------------------------- stage 1: absorb split
+
+
+def test_absorb_split_executes_identically(ray_cluster):
+    """Default knobs: frames park in the ingest deque and a dedicated
+    absorb thread (not the conn thread) unpickles them — and every
+    result comes back exactly as the classic path would deliver it."""
+    w = _worker()
+    lm = w._lease_mgr
+    assert lm is not None and lm._absorb_exec is not None
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get([f.remote(i) for i in range(64)]) == [
+        i * 2 for i in range(64)]
+    # The executor actually ran (its worker thread only spawns on the
+    # first submitted frame) and drained everything it parked.
+    assert any(t.name.startswith("rtpu-completion-absorb")
+               for t in threading.enumerate())
+    assert len(lm._ingest) == 0
+
+
+def test_absorb_disabled_classic_wire():
+    """Knob off: no absorb executor exists, the ingest deque is never
+    touched, and the worker ships the legacy lease_tasks_done dict —
+    results still correct (off-path byte-identical behavior)."""
+    _cluster(completion_absorb_enabled=False)
+    try:
+        w = _worker()
+        lm = w._lease_mgr
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 3
+
+        assert ray_tpu.get([f.remote(i) for i in range(64)]) == [
+            i * 3 for i in range(64)]
+        assert lm._absorb_exec is None
+        assert len(lm._ingest) == 0
+        assert not any(t.name.startswith("rtpu-completion-absorb")
+                       for t in threading.enumerate())
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_absorb_failure_raises_typed_error(ray_cluster):
+    """A frame the absorb stage cannot decode fails the lease's pending
+    returns with CompletionAbsorbError — get() raises it promptly
+    instead of hanging on a completion event nobody will ever set."""
+    w = _worker()
+    lm = w._lease_mgr
+
+    @ray_tpu.remote
+    def stall():
+        time.sleep(60)
+
+    ref = stall.remote()
+    lm.flush_sends()
+    _wait_for(lambda: len(lm._task_lease) >= 1, msg="lease in flight")
+    lease = next(iter(lm._task_lease.values()))[0]
+    # Drive the absorb path exactly as _drain_ingest would, with a
+    # frame that cannot unpickle.
+    lm._absorb_frame(lease, [b"\x80garbage-not-a-pickle"])
+    with pytest.raises(CompletionAbsorbError):
+        ray_tpu.get(ref, timeout=10)
+
+
+# ------------------------------------------ stage 2: completion ring
+
+
+def test_ring_records_absorb_into_inline_cache(ray_cluster):
+    """An NM-relayed record lands its inline blob in the driver's
+    process cache and retires the pending-returns window entry without
+    any socket traffic."""
+    w = _worker()
+    _activate_ring(w)
+    oid = os.urandom(28)
+    w._pending_returns[oid] = None
+    _nm()._relay_completion_rings([_record_blob(oid, b"payload-bytes")])
+    _wait_for(lambda: oid in w._inline, msg="ring record absorbed")
+    assert w._inline.get(oid) == b"payload-bytes"
+    assert oid not in w._pending_returns
+
+
+def test_ring_full_falls_back_counted(ray_cluster):
+    """With the consumer stalled, a full ring makes append() refuse —
+    the NM counts the drop (driver_completion_ring_full_total) and
+    relies on the unconditional GCS relay; once the consumer resumes
+    it drains the backlog and appends succeed again."""
+    w = _worker()
+    _activate_ring(w)
+    nm = _nm()
+    ent = next(ents[0] for ents in nm._completion_rings.values() if ents)
+    producer = ent["producer"]
+
+    w._comp_ring_pause = True   # consumer idles; head stops moving
+    try:
+        big = _record_blob(os.urandom(28), b"x" * 65536)
+        for _ in range(4096):
+            if not producer.append(big):
+                break
+        else:
+            pytest.fail("ring never filled")
+
+        counter = nm_mod._comp_ring_full_counter()
+        before = sum(counter._values.values())
+        nm._relay_completion_rings([_record_blob(os.urandom(28),
+                                                 b"y" * 65536)])
+        assert sum(counter._values.values()) > before
+    finally:
+        w._comp_ring_pause = False
+    # Consumer catches up: the backlog drains and the ring takes
+    # appends again.
+    _wait_for(lambda: producer.append(_record_blob(os.urandom(28), b"z")),
+              msg="ring drained after stall")
+
+
+def test_nm_death_unconsumed_records_recovered(ray_cluster):
+    """Records a dead NM left in the ring are plain shared memory: the
+    driver finishes draining them (no stranded record) and redelivered
+    blobs are idempotent (no double-deliver)."""
+    w = _worker()
+    _activate_ring(w)
+    nm = _nm()
+    ent = next(ents[0] for ents in nm._completion_rings.values() if ents)
+    producer = ent["producer"]
+    ring = w._comp_ring
+    ring_path = ring.path
+
+    oids = [os.urandom(28) for _ in range(3)]
+    blobs = [_record_blob(o, b"val-%d" % i) for i, o in enumerate(oids)]
+
+    w._comp_ring_pause = True
+    try:
+        for b in blobs:
+            assert producer.append(b)
+        # "NM dies": the producer goes away mid-ring. close() flags the
+        # ring closed and rings the bell but NEVER unlinks — the
+        # unconsumed records stay valid shm for the driver to finish.
+        producer.close()
+        with nm._lock:
+            for ents in nm._completion_rings.values():
+                ents[:] = [e for e in ents if e is not ent]
+    finally:
+        w._comp_ring_pause = False
+
+    for i, o in enumerate(oids):
+        _wait_for(lambda o=o: o in w._inline, msg="post-death drain")
+        assert w._inline.get(o) == b"val-%d" % i
+    # Redelivery (the GCS copy arriving later, or a replayed frame) is
+    # a no-op, not a double-deliver.
+    for b in blobs:
+        w._absorb_completion_record(b)
+    for i, o in enumerate(oids):
+        assert w._inline.get(o) == b"val-%d" % i
+    # Producer closed + drained => the consumer loop exits and unlinks.
+    _wait_for(lambda: not os.path.exists(ring_path),
+              msg="ring unlink after producer close")
+
+
+def test_driver_shutdown_unlinks_ring_files():
+    """Driver shutdown must unlink both the ring file and the doorbell
+    socket — a leaked mmap would have the NM producing into a file no
+    one will ever drain."""
+    _cluster()
+    try:
+        w = _worker()
+        _activate_ring(w)
+        path = w._comp_ring.path
+        assert os.path.exists(path)
+    finally:
+        ray_tpu.shutdown()
+    deadline = time.time() + 5
+    while time.time() < deadline and os.path.exists(path):
+        time.sleep(0.05)
+    assert not os.path.exists(path), "ring file leaked"
+    assert not os.path.exists(path + ".bell"), "doorbell socket leaked"
+
+
+def test_ring_disabled_never_registers():
+    """Knob off: the driver never creates a ring file and the NM never
+    gains a producer — the socket/GCS path carries everything."""
+    _cluster(completion_ring_enabled=False)
+    try:
+        w = _worker()
+
+        @ray_tpu.remote
+        def f():
+            return 7
+
+        assert ray_tpu.get(f.remote()) == 7
+        time.sleep(0.2)
+        assert w._comp_ring_state == 0
+        assert w._comp_ring is None
+        assert not any(_nm()._completion_rings.values())
+    finally:
+        ray_tpu.shutdown()
+
+
+# --------------------------------- stage 3: parallel wave collection
+
+
+def test_get_and_wait_steal_parked_frames(ray_cluster):
+    """With the absorb executor wedged (frames park but nothing drains
+    them), a caller blocking on a lease completion steals the parked
+    frame onto its OWN thread: get() returns the value and wait()
+    reports readiness without the GCS round trip — neither idles on an
+    event only the dead executor would have set."""
+    w = _worker()
+    lm = w._lease_mgr
+    real_submit = lm._absorb_submit
+    lm._absorb_submit = lambda: None   # frames park; nothing drains
+    try:
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 100
+
+        ref = f.remote(7)
+        lm.flush_sends()
+        _wait_for(lambda: len(lm._ingest) > 0, msg="parked frame")
+        assert ray_tpu.get(ref, timeout=15) == 107
+        assert len(lm._ingest) == 0   # the caller thread absorbed it
+
+        ref2 = f.remote(8)
+        lm.flush_sends()
+        _wait_for(lambda: len(lm._ingest) > 0, msg="second parked frame")
+        ready, rest = ray_tpu.wait([ref2], num_returns=1, timeout=15)
+        assert ready == [ref2] and not rest
+        assert ray_tpu.get(ref2, timeout=15) == 108
+    finally:
+        lm._absorb_submit = real_submit
+
+
+def test_steal_disabled_gate():
+    """completion_steal_enabled=False: steal_absorb() is a hard no-op
+    and blocking collection leans on the absorb executor alone."""
+    _cluster(completion_steal_enabled=False)
+    try:
+        w = _worker()
+        lm = w._lease_mgr
+        assert lm._steal is False
+        assert lm.steal_absorb() is False
+
+        @ray_tpu.remote
+        def f(x):
+            return x - 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(16)]) == [
+            i - 1 for i in range(16)]
+    finally:
+        ray_tpu.shutdown()

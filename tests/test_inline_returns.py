@@ -473,6 +473,32 @@ def test_wait_pops_resolved_pending_returns(ray_cluster):
     assert ref._id.binary() not in w._pending_returns
 
 
+def test_wait_stops_probing_after_num_returns_satisfied(ray_cluster):
+    """wait(num_returns=k) must stop scanning once k refs are ready:
+    the result only takes the first k ready refs, so probing the rest
+    re-pays a ctypes store.contains per ref on every poll iteration
+    for refs the caller already collected (SCALE_r10 small fix)."""
+    w = worker_mod.global_worker()
+    refs = [ray_tpu.put(i) for i in range(16)]
+    calls = []
+    real = w.store.contains
+
+    def counting(oid):
+        calls.append(oid)
+        return real(oid)
+
+    w.store.contains = counting
+    try:
+        ready, rest = ray_tpu.wait(refs, num_returns=1, timeout=10)
+    finally:
+        w.store.contains = real
+    assert len(ready) == 1 and len(rest) == 15
+    # fetch_local may legitimately re-probe the ONE ready ref; the scan
+    # must not have touched the other fifteen.
+    assert len(set(calls)) <= 1, \
+        f"scanned past num_returns: {len(set(calls))} distinct probes"
+
+
 def test_pool_pressure_ignores_chip_starved_tpu_specs():
     """A queue holding only TPU specs waiting for chips must not grow
     the shared CPU pool: a pool worker spawned for them could never run
