@@ -25,6 +25,10 @@ Toggles:
   submit_ring       RAY_TPU_SUBMIT_RING_ENABLED — shm submit ring to
                     the same-node NM vs the socket batch path
                     (SCALE_r08 stage 3)
+  worker_completion_ring
+                    RAY_TPU_WORKER_COMPLETION_RING_ENABLED — worker->
+                    driver shm completion segments vs the socket
+                    lease_tasks_done_b frames (ISSUE 17)
 
 Run:  python benchmarks/microbench_compare.py [rounds] [out.json] [toggle]
 """
@@ -80,6 +84,12 @@ TOGGLES = {
                         "blobs absorb into the driver via memcpy + "
                         "doorbell instead of waiting on the GCS relay "
                         "— vs the socket/GCS-only delivery path"),
+    "worker_completion_ring": (
+        "RAY_TPU_WORKER_COMPLETION_RING_ENABLED",
+        "worker->driver shm completion segments — same-node leased "
+        "workers append lease completion blobs into a per-worker "
+        "segment of the driver's completion ring (no socket send on "
+        "the return path) — vs the lease_tasks_done_b socket frames"),
 }
 
 

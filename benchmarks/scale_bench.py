@@ -30,13 +30,19 @@ Prints one JSON line per metric. Run: python benchmarks/scale_bench.py
 [N_tasks] [K_actors] [--gcs-out-of-process {0,1}]
 [--profile-submit OUT.speedscope.json] [--drivers N]
 [--submit-fastpath {0,1}] [--inline-returns {0,1}]
-[--completion-fastpath {0,1}]
+[--completion-fastpath {0,1}] [--worker-ring {0,1}]
 [--profile-turnaround OUT.speedscope.json].
 
 ``--completion-fastpath`` pins all THREE driver-side completion
 ingestion stages (RAY_TPU_COMPLETION_{ABSORB,RING,STEAL}_ENABLED) for
 this run and every child driver: the SCALE_r10 A/B is two runs of this
 script, 1 vs 0, same box.
+
+``--worker-ring`` pins the worker->driver shm completion segments
+(RAY_TPU_WORKER_COMPLETION_RING_ENABLED) independently of
+``--completion-fastpath``: the SCALE_r11 A/B is two runs, 1 vs 0, on
+top of an identical completion-ring setup, isolating the segment
+transport itself.
 
 ``--inline-returns`` pins BOTH result-return fast-path stages
 (RAY_TPU_WORKER_INLINE_RETURNS_ENABLED /
@@ -162,6 +168,7 @@ def main():
     submit_fastpath = None
     inline_returns = None
     completion_fastpath = None
+    worker_ring = None
     n_drivers = 3
     i = 0
     while i < len(argv):
@@ -198,6 +205,14 @@ def main():
                 i += 1
                 v = argv[i]
             completion_fastpath = v.strip().lower() not in (
+                "0", "false", "off") if v else True
+        elif a.startswith("--worker-ring"):
+            _, eq, v = a.partition("=")
+            if not eq and i + 1 < len(argv) and argv[i + 1].lower() in (
+                    "0", "1", "true", "false", "on", "off"):
+                i += 1
+                v = argv[i]
+            worker_ring = v.strip().lower() not in (
                 "0", "false", "off") if v else True
         elif a.startswith("--profile-turnaround"):
             _, eq, v = a.partition("=")
@@ -240,6 +255,12 @@ def main():
     if completion_fastpath is not None:
         for k in _COMPLETION_KNOBS:
             os.environ["RAY_TPU_" + k] = "1" if completion_fastpath else "0"
+    # Worker->driver shm completion segments (ISSUE 17): pinned
+    # separately from --completion-fastpath so the A/B isolates the
+    # segment transport on top of an otherwise-identical ring setup.
+    if worker_ring is not None:
+        os.environ["RAY_TPU_WORKER_COMPLETION_RING_ENABLED"] = \
+            "1" if worker_ring else "0"
 
     import ray_tpu
     from ray_tpu._private.config import config as _cfg
@@ -280,6 +301,11 @@ def main():
             "steal": bool(_cfg.completion_steal_enabled)},
         "toggle": "--completion-fastpath / RAY_TPU_COMPLETION_"
                   "{ABSORB,RING,STEAL}_ENABLED"}), flush=True)
+    print(json.dumps({
+        "metric": "worker_ring",
+        "value": bool(_cfg.worker_completion_ring_enabled),
+        "toggle": "--worker-ring / "
+                  "RAY_TPU_WORKER_COMPLETION_RING_ENABLED"}), flush=True)
     from ray_tpu._private import worker as worker_mod
     try:
         @ray_tpu.remote(resources={"impossible": 1})

@@ -1,318 +1,105 @@
-"""Shared-memory completion ring: same-node node manager -> driver.
+"""Shared-memory completion transport into a same-node driver.
 
-The submit ring's return-path twin (SCALE_r10 stage 2): a driver whose
-node manager lives on the same box stops learning classic-path task
-completions through GCS round trips. The NM relays each worker
-``task_done_batch`` record blob into a per-driver SPSC byte ring in a
-mmapped session file AS WELL AS to the GCS (the socket path stays
-authoritative); the driver's consumer thread unpickles the records,
-parks inline values in its InlineCache and retires its pending-returns
-entries, so the next ``get()``/``wait()`` resolves locally. The NM
-never unpickles a blob — relay is memcpy + tail publish.
+Two producer families feed a driver's completion ingestion without a
+socket on the hot path:
 
-Role inversion relative to ``submit_ring``: here the DRIVER is the
-consumer — it creates the ring file, owns the doorbell socket, and
-beats the heartbeat — while the NM is the producer that maps the
-existing file and appends. That inversion decides every lifecycle
-rule below:
+1. The NM relay (SCALE_r10 stage 2): the node manager relays each
+   classic-path worker ``task_done_batch`` record blob into the
+   driver's main ring AS WELL AS to the GCS (the socket path stays
+   authoritative). Role inversion relative to ``submit_ring``: here
+   the DRIVER is the consumer — it creates the ring file, owns the
+   doorbell socket, and beats the heartbeat — while the NM is the
+   producer that maps the existing file and appends.
 
-- ring full     -> the producer skips the append (the GCS relay it
-  already made delivers the record; driver_completion_ring_full_total
-  counts the miss);
+2. Worker segments (ISSUE 17): a same-node LEASED worker appends its
+   lease-completion record blobs directly into a per-worker SPSC
+   SEGMENT alongside the driver's main ring, skipping its holder conn
+   entirely. The segment is a separate file (``<ring>.w<pid>_<n>``)
+   the WORKER creates after the driver advertises its ring over the
+   lease conn; the driver maps it (SegmentConsumer), acks, and drains
+   it from the same consumer thread that drains the main ring. The
+   segments share the main ring's doorbell — the worker's producer
+   dials ``<ring>.bell`` — so one park covers every producer, and the
+   driver flags each segment parked around its main-ring park.
+
+Lifecycle rules (both families; the creation-ownership rule is the
+``shm_ring`` default):
+
+- ring full     -> the producer skips the append; the NM's GCS relay
+  (family 1) or the worker's socket ``lease_tasks_done_b`` fallback
+  (family 2) delivers the record, and a counter records the miss;
 - driver death  -> the consumer heartbeat goes stale; the producer
-  tears its mapping down (the driver's NM conn close is the prompt
-  path, staleness the backstop for a wedged driver);
-- NM death      -> records already in the ring are still valid shared
-  memory; the driver keeps draining them (unconsumed-record recovery
-  is just "finish the drain"), and delivery stays at-least-once
-  because every absorb step is redelivery-idempotent and the GCS path
-  dedups on task id;
-- teardown      -> the producer's close() must NOT unlink the file:
-  the driver owns it and unlinks on disconnect.
+  tears its mapping down (conn close is the prompt path, staleness
+  the backstop for a wedged driver);
+- producer death-> records already in the ring/segment are still
+  valid shared memory; the driver keeps draining them, and delivery
+  stays at-least-once because every absorb step is
+  redelivery-idempotent;
+- teardown      -> the NM producer never unlinks (the driver owns the
+  main ring); a worker unlinks its OWN segment on graceful close, and
+  the driver force-unlinks mapped segments on detach so a SIGKILLed
+  worker cannot leak one (double-unlink is idempotent).
 
-Doorbell, park bound, and memory-model caveats are identical to the
-submit ring (see its module docstring): payload-before-tail relies on
-x86-64 TSO store-store ordering, so the driver only registers a ring
-on x86-64.
-
-Layout (offsets in bytes; all fields little-endian u64 unless noted):
-    0   magic "RTCOMPR1"
-    8   data capacity
-    16  tail (producer cursor, monotonically increasing)
-    24  head (consumer cursor)
-    32  consumer parked flag
-    40  producer closed flag
-    48  consumer heartbeat (f64 CLOCK_MONOTONIC seconds)
-    64  data region (byte ring of [u32 length][payload] records)
+Doorbell, park bound, and memory-model caveats live in ``shm_ring``:
+payload-before-tail relies on x86-64 TSO store-store ordering, so
+rings and segments are only enabled on x86-64.
 """
 
 from __future__ import annotations
 
-import mmap
-import os
-import socket
-import struct
-import threading
-import time
-from typing import List, Optional, Tuple
+from ray_tpu._private import shm_ring
 
 MAGIC = b"RTCOMPR1"
-HDR_SIZE = 64
-_OFF_CAPACITY = 8
-_OFF_TAIL = 16
-_OFF_HEAD = 24
-_OFF_PARKED = 32
-_OFF_CLOSED = 40
-_OFF_BEAT = 48
-
-_U64 = struct.Struct("<Q")
-_F64 = struct.Struct("<d")
-_LEN = struct.Struct("<I")
-
-# Consumer park bound: also the worst-case delivery delay added by the
-# parked-flag/tail publication race (no cross-process fence in pure
-# Python; see submit_ring's module docstring).
-PARK_TIMEOUT_S = 0.1
+SEG_MAGIC = b"RTWSEGR1"
+HDR_SIZE = shm_ring.HDR_SIZE
+PARK_TIMEOUT_S = shm_ring.PARK_TIMEOUT_S
 
 
-class _Mapped:
-    """Shared mmap plumbing for both ends."""
-
-    def __init__(self, path: str, create: bool, capacity: int = 0):
-        self.path = path
-        if create:
-            fd = os.open(path, os.O_CREAT | os.O_TRUNC | os.O_RDWR, 0o600)
-            try:
-                os.ftruncate(fd, HDR_SIZE + capacity)
-                self._mm = mmap.mmap(fd, HDR_SIZE + capacity)
-            finally:
-                os.close(fd)
-            self._mm[0:8] = MAGIC
-            self._mm[_OFF_CAPACITY:_OFF_CAPACITY + 8] = _U64.pack(capacity)
-            self.capacity = capacity
-        else:
-            fd = os.open(path, os.O_RDWR)
-            try:
-                size = os.fstat(fd).st_size
-                self._mm = mmap.mmap(fd, size)
-            finally:
-                os.close(fd)
-            if self._mm[0:8] != MAGIC:
-                self._mm.close()
-                raise ValueError(f"not a completion ring: {path}")
-            self.capacity = _U64.unpack(
-                self._mm[_OFF_CAPACITY:_OFF_CAPACITY + 8])[0]
-
-    def _get(self, off: int) -> int:
-        return _U64.unpack_from(self._mm, off)[0]
-
-    def _put(self, off: int, val: int) -> None:
-        _U64.pack_into(self._mm, off, val)
-
-    def _read_data(self, pos: int, n: int) -> bytes:
-        """Wrap-aware read of n bytes at ring position pos."""
-        cap = self.capacity
-        i = pos % cap
-        if i + n <= cap:
-            return bytes(self._mm[HDR_SIZE + i:HDR_SIZE + i + n])
-        first = cap - i
-        return bytes(self._mm[HDR_SIZE + i:HDR_SIZE + cap]) + \
-            bytes(self._mm[HDR_SIZE:HDR_SIZE + n - first])
-
-    def _write_data(self, pos: int, data: bytes) -> None:
-        cap = self.capacity
-        i = pos % cap
-        n = len(data)
-        if i + n <= cap:
-            self._mm[HDR_SIZE + i:HDR_SIZE + i + n] = data
-        else:
-            first = cap - i
-            self._mm[HDR_SIZE + i:HDR_SIZE + cap] = data[:first]
-            self._mm[HDR_SIZE:HDR_SIZE + n - first] = data[first:]
-
-    def close_map(self) -> None:
-        try:
-            self._mm.close()
-        except (BufferError, ValueError):
-            pass
-
-
-class RingConsumer(_Mapped):
-    """Driver side: creates the ring file, owns the doorbell socket,
-    beats the consumer heartbeat the producer watches for liveness."""
+class RingConsumer(shm_ring.Consumer):
+    """Driver side of the main ring: creates the ring file, owns the
+    doorbell socket, beats the consumer heartbeat the producers watch
+    for liveness. close() unlinks both files (creation ownership)."""
 
     def __init__(self, path: str, capacity: int):
-        super().__init__(path, create=True, capacity=capacity)
-        self._head = 0
-        self._bell = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
-        try:
-            os.unlink(path + ".bell")
-        except FileNotFoundError:
-            pass
-        self._bell.bind(path + ".bell")
-        self._bell.settimeout(PARK_TIMEOUT_S)
-        self.stopped = False
-        # First heartbeat at creation: the producer's staleness check
-        # must never see a zero beat between registration and the
-        # consumer thread's first loop.
-        self.beat()
-
-    def beat(self) -> None:
-        _F64.pack_into(self._mm, _OFF_BEAT, time.monotonic())
-
-    def producer_closed(self) -> bool:
-        return bool(self._get(_OFF_CLOSED))
-
-    def pending(self) -> bool:
-        return self._get(_OFF_TAIL) > self._head
-
-    def drain(self, max_records: int = 512) -> Tuple[List[bytes], int]:
-        """Read up to max_records pending records WITHOUT advancing the
-        shared head. Returns (blobs, new_head); the caller commits the
-        head only after the records are absorbed (at-least-once — every
-        absorb step is redelivery-idempotent)."""
-        tail = self._get(_OFF_TAIL)
-        pos = self._head
-        out: List[bytes] = []
-        while pos < tail and len(out) < max_records:
-            (n,) = _LEN.unpack(self._read_data(pos, _LEN.size))
-            out.append(self._read_data(pos + _LEN.size, n))
-            pos += _LEN.size + n
-        return out, pos
-
-    def commit(self, new_head: int) -> None:
-        self._head = new_head
-        self._put(_OFF_HEAD, new_head)
-
-    def park_wait(self) -> None:
-        """Park until the producer rings the bell (bounded; see
-        PARK_TIMEOUT_S). Caller re-checks the ring either way."""
-        self._put(_OFF_PARKED, 1)
-        try:
-            # Lost-wakeup guard: a record published between our last
-            # drain and the flag store is caught by this re-check; the
-            # bounded recv covers the symmetric store-load race.
-            if self._get(_OFF_TAIL) > self._head:
-                return
-            try:
-                # raylint: disable-next=unbounded-wait (bounded: the
-                # socket carries a PARK_TIMEOUT_S settimeout set at
-                # construction)
-                self._bell.recv(64)
-            except socket.timeout:
-                pass
-            except OSError:
-                time.sleep(PARK_TIMEOUT_S)
-        finally:
-            self._put(_OFF_PARKED, 0)
-
-    def close(self) -> None:
-        """Driver teardown: the consumer owns BOTH session files — no
-        mmap or doorbell may outlive the driver."""
-        self.stopped = True
-        try:
-            self._bell.close()
-        except OSError:
-            pass
-        try:
-            os.unlink(self.path + ".bell")
-        except OSError:
-            pass
-        self.close_map()
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
+        super().__init__(path, MAGIC, create=True, capacity=capacity,
+                         kind="completion ring")
 
 
-class RingProducer(_Mapped):
-    """NM side: maps the driver-created ring and appends record blobs.
-    Appends come from any worker-conn serve thread; the lock serializes
-    them into the single logical producer the layout requires."""
-
-    # Same bell rate-limit rationale as the submit ring's writer: only
-    # a deep backlog (which guarantees further appends) may suppress a
-    # bell; a burst's last record always rings.
-    BELL_MIN_INTERVAL_S = 0.005
+class RingProducer(shm_ring.Producer):
+    """NM side of the main ring: maps the driver-created ring and
+    appends record blobs. Appends come from any worker-conn serve
+    thread; the core's lock serializes them into the single logical
+    producer the layout requires. close() never unlinks — the driver
+    owns the file and removes it on disconnect."""
 
     def __init__(self, path: str):
-        super().__init__(path, create=False)
-        # The producer maps an EXISTING file: resume at the published
-        # tail (0 for a fresh ring).
-        self._tail = self._get(_OFF_TAIL)
-        self._lock = threading.Lock()
-        self._bell: Optional[socket.socket] = None
-        self._last_bell = 0.0
-        self.dead = False
+        super().__init__(path, MAGIC, kind="completion ring")
 
-    def connect_bell(self) -> None:
-        s = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
-        s.setblocking(False)
-        s.connect(self.path + ".bell")
-        self._bell = s
 
-    def append(self, blob: bytes) -> bool:
-        """One record in, or False on ring-full / dead ring. A False is
-        not a failure: the GCS relay already carries the record."""
-        n = _LEN.size + len(blob)
-        with self._lock:
-            if self.dead:
-                return False
-            head = self._get(_OFF_HEAD)
-            if self.capacity - (self._tail - head) < n:
-                return False
-            self._write_data(self._tail, _LEN.pack(len(blob)) + blob)
-            # Publish AFTER the payload bytes: the consumer loads tail
-            # first, so it can never read an unwritten record.
-            self._tail += n
-            self._put(_OFF_TAIL, self._tail)
-            parked = self._get(_OFF_PARKED)
-            backlog = self._tail - head
-        if parked:
-            now = time.monotonic()
-            if backlog <= 4096 \
-                    or now - self._last_bell >= self.BELL_MIN_INTERVAL_S:
-                self._last_bell = now
-                self._ring_bell()
-        return True
+class SegmentProducer(shm_ring.Producer):
+    """Worker side of a completion segment: creates its own segment
+    file next to the driver's advertised ring and dials the driver's
+    MAIN ring bell (shared doorbell). Declines every append until the
+    driver maps the segment and acks (``active``) — until then, and
+    whenever the segment is full or the consumer heartbeat goes stale,
+    completions fall back to the socket ``lease_tasks_done_b`` path.
+    close() unlinks the worker-created file (the driver's force-unlink
+    on detach makes the remove idempotent from either side)."""
 
-    def _ring_bell(self) -> None:
-        s = self._bell
-        if s is None:
-            return
-        try:
-            s.send(b"!")
-        except (BlockingIOError, OSError):
-            pass   # a wakeup is already pending, or the driver is gone
-        # (either way the bounded park covers it)
+    def __init__(self, path: str, capacity: int, bell_path: str):
+        super().__init__(path, SEG_MAGIC, create=True, capacity=capacity,
+                         bell_path=bell_path, active=False,
+                         kind="completion segment")
 
-    def consumer_stale(self, budget_s: float) -> bool:
-        """True when records are pending but the consumer heartbeat has
-        not moved for budget_s — the driver (or its consumer thread) is
-        gone and this ring should be torn down."""
-        if self.dead:
-            return False
-        with self._lock:
-            pending = self._tail > self._get(_OFF_HEAD)
-        if not pending:
-            return False
-        beat = _F64.unpack_from(self._mm, _OFF_BEAT)[0]
-        return (time.monotonic() - beat) > budget_s
 
-    def close(self) -> None:
-        """Producer teardown: flag closed, wake the consumer so it
-        observes the flag, unmap. Never unlink — the driver owns the
-        file and removes it on disconnect."""
-        with self._lock:
-            self.dead = True
-            try:
-                self._put(_OFF_CLOSED, 1)
-            except (ValueError, IndexError):
-                pass
-        self._ring_bell()
-        if self._bell is not None:
-            try:
-                self._bell.close()
-            except OSError:
-                pass
-        self.close_map()
+class SegmentConsumer(shm_ring.Consumer):
+    """Driver side of a completion segment: maps the worker-created
+    file. No bell of its own — the main ring's bell wakes the shared
+    consumer thread, which flags this segment parked around its park
+    (``set_parked``) so the producer knows when to ring. Detach calls
+    close(unlink=True): the driver force-removes segments so a
+    SIGKILLed worker cannot leak one."""
+
+    def __init__(self, path: str):
+        super().__init__(path, SEG_MAGIC, bind_bell=False,
+                         kind="completion segment")

@@ -354,6 +354,25 @@ _d("completion_ring_bytes", 4 * 1024 * 1024,
    "Data capacity of the per-driver completion ring. At ~300 bytes "
    "per small-return completion record the default holds ~13k "
    "undrained completions before appends spill to the GCS-only path.")
+_d("worker_completion_ring_enabled", True,
+   "Worker->driver shm completion segments (ISSUE 17): a same-node "
+   "leased worker appends its lease-completion record blobs directly "
+   "into a per-worker SPSC segment beside the caller driver's "
+   "completion ring (advertised over the lease conn at grant time, "
+   "armed only after the driver maps it and acks), so the same-node "
+   "submit->execute->collect loop crosses zero sockets in steady "
+   "state. Segment-full, attach failure, cross-node callers, and the "
+   "knob off all fall back to the socket lease_tasks_done_b path "
+   "(worker_completion_ring_full_total counts full-segment spills); "
+   "driver death is detected by consumer-heartbeat staleness on the "
+   "segment. x86-64 only, like every shm ring. The "
+   "'worker_completion_ring' toggle in benchmarks/microbench_compare"
+   ".py.")
+_d("worker_completion_ring_bytes", 1024 * 1024,
+   "Data capacity of each per-worker completion segment. At ~300 "
+   "bytes per small-return completion record the default holds ~3k "
+   "undrained completions per worker before appends spill to the "
+   "socket path.")
 _d("completion_steal_enabled", True,
    "Parallel wave collection (SCALE_r10 stage 3): a get()/wait() "
    "caller about to block drains the completion ingest queue on its "

@@ -2839,6 +2839,17 @@ class GcsServer:
         with self._kv_lock:
             self._task_events.extend(p)
 
+    def _h_task_events_b(self, conn, p, msg_id):
+        """Blob-framed variant: the NM relays each worker's event batch
+        as the single pre-pickled frame the worker shipped (one worker
+        send feeds both the flight recorder and this timeline)."""
+        try:
+            events = pickle.loads(p)
+        except Exception:
+            return
+        with self._kv_lock:
+            self._task_events.extend(events)
+
     # ------------------------------------------------- state API (reference:
     # dashboard/state_aggregator.py:134 StateAPIManager fan-out; here the
     # GCS holds all tables, so listing is a straight read)

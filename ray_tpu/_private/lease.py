@@ -245,6 +245,12 @@ class LeaseManager:
         self._ingest: collections.deque = collections.deque()
         self._absorb_enabled = bool(config.completion_absorb_enabled)
         self._steal = bool(config.completion_steal_enabled)
+        # Worker->driver shm completion segments (ISSUE 17): same-node
+        # leased workers append their completion blobs straight into a
+        # per-worker segment next to our completion ring, skipping the
+        # lease conn. Advertised per-lease in _install_lease once the
+        # main ring is active; absorbed via ring_absorb.
+        self._worker_ring = bool(config.worker_completion_ring_enabled)
         self._absorb_exec = (concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="rtpu-completion-absorb")
             if self._absorb_enabled else None)
@@ -757,6 +763,11 @@ class LeaseManager:
                     # off): absorb inline — always correct, just the
                     # pre-split cost profile.
                     self._absorb_frame(lse, payload)
+            elif mtype == protocol.ATTACH_COMPLETION_SEGMENT:
+                # Worker created its completion segment next to our
+                # ring; map it and ack so the worker arms its producer
+                # (no ack -> the worker stays on the socket path).
+                self._w._attach_worker_segment(payload["path"], conn)
         return on_msg
 
     # ------------------------------------------------- completion absorb
@@ -785,6 +796,64 @@ class LeaseManager:
             self._on_tasks_done(lease, results, defer_send=True)
         except BaseException as e:
             self._absorb_failed(lease, e)
+
+    def ring_absorb(self, blobs: List[bytes]) -> None:
+        """Absorb worker-segment completion blobs (ISSUE 17). Runs on
+        the driver's ring consumer thread. Unlike the socket frames —
+        which arrive on a per-lease conn — segment blobs carry no lease
+        identity, so each record routes through the task_id index.
+        Redelivery-idempotent: a record whose task already completed
+        (socket fallback raced the segment, or a re-drain after a torn
+        commit) finds no _task_lease entry and drops here; records for
+        a live lease re-use the one absorb path (_on_tasks_done pops
+        lease.pending, so a duplicate inside it no-ops too)."""
+        by_lease: Dict[_Lease, List[dict]] = {}
+        for blob in blobs:
+            try:
+                rec = pickle.loads(blob)
+                tid = rec["task_id"]
+            except BaseException:
+                continue   # torn/corrupt blob: socket fallback delivers
+            with self._lock:
+                ent = self._task_lease.get(tid)
+            if ent is None:
+                continue   # already completed via another path
+            by_lease.setdefault(ent[0], []).append(rec)
+        for lease, recs in by_lease.items():
+            try:
+                self._on_tasks_done(lease, recs, defer_send=True)
+            except BaseException as e:
+                self._absorb_failed(lease, e)
+
+    def advertise_worker_ring(self) -> None:
+        """The completion ring just came up: advertise it to every
+        already-installed same-node lease (leases installed later get
+        the advertisement inline in _install_lease). Idempotent on the
+        worker side — a repeat attach for a conn is ignored."""
+        if not self._worker_ring:
+            return
+        with self._lock:
+            leases = [l for st in self._shapes.values()
+                      for l in st.leases if not l.dead]
+        for lease in leases:
+            self._advertise_ring(lease)
+
+    def _advertise_ring(self, lease: _Lease) -> None:
+        """Tell a same-node leased worker where our completion ring
+        lives; the worker answers with attach_completion_segment and
+        we ack. Cross-node leases never get one — the segment is a
+        same-filesystem mmap."""
+        ring = self._w._comp_ring
+        if (ring is None or self._w._comp_ring_state != 2
+                or not self._worker_ring
+                or lease.node_id != self._w.node_id):
+            return
+        try:
+            lease.conn.notify(protocol.ATTACH_COMPLETION_RING,
+                              {"path": ring.path,
+                               "node_id": self._w.node_id})
+        except Exception:
+            pass   # conn dying: its close path retires the lease
 
     def _absorb_failed(self, lease: _Lease, e: BaseException):
         """Absorption died on a frame (corrupt blob, absorb bug): a
@@ -937,6 +1006,10 @@ class LeaseManager:
         if lease.dead:
             self._drop_lease(lease)
             return
+        # Same-node worker + active completion ring: advertise the ring
+        # so the worker opens its shm segment (ISSUE 17). If the ring
+        # comes up later, _register_completion_ring re-advertises.
+        self._advertise_ring(lease)
         if to_send:
             self._send(lease, to_send)
 
@@ -1173,6 +1246,17 @@ class LeaseManager:
     def _on_lease_conn_closed(self, lease: _Lease):
         # Worker (or its node) died: every in-flight spec on this lease
         # falls back to the scheduled path; then retire the lease.
+        # First give the ring consumer a bounded moment to finish
+        # draining this worker's completion segment — a graceful exit
+        # flushes its last results into the segment right before the
+        # conn drops, and results that beat the death should resolve
+        # instead of re-running (the consumer loop passes at least
+        # every PARK_TIMEOUT_S, so this settles in one tick).
+        self._w._detach_worker_segments(lease.conn)
+        deadline = time.monotonic() + 0.5
+        while self._w._has_segments_for_conn(lease.conn) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
         with self._lock:
             lease.dead = True
             specs = list(lease.pending.values())

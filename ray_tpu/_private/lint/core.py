@@ -58,6 +58,12 @@ CONTROL_PLANE = (
     # under a per-ring lock — an unbounded park or a blocking call
     # under that lock stalls completion delivery for a whole node.
     "ray_tpu/_private/completion_ring.py",
+    # The factored SPSC core under BOTH rings and the worker
+    # completion segments: its park/bell/heartbeat discipline is the
+    # liveness contract of every shm transport — an unbounded park or
+    # a blocking call under its append lock stalls submit AND
+    # completion delivery everywhere at once.
+    "ray_tpu/_private/shm_ring.py",
     # The inline-object tables back every get()/deserialize_args and
     # sit under the GCS object shard and the lease completion handler —
     # a blocking call under their leaf locks would invert the whole

@@ -23,6 +23,7 @@ import itertools
 import json
 import logging
 import os
+import pickle
 import signal
 import socket
 import subprocess
@@ -315,6 +316,13 @@ class NodeManager:
         # up on disconnect or consumer-heartbeat staleness.
         self._completion_rings: Dict[Any, List[dict]] = {}
 
+        # Worker->driver completion segments (ISSUE 17): workers report
+        # each segment file they create so this NM can unlink leftovers
+        # if the worker dies without its own close running (SIGKILL
+        # between create and the driver mapping it — the driver's
+        # force-unlink only covers segments it mapped). conn -> {path}.
+        self._worker_segments: Dict[Any, set] = {}
+
         # Server for workers, remote pullers, and actor-task callers.
         self.server = protocol.Server(self._handle_server, name=f"nm-{node_name}")
         self.server.on_disconnect = self._on_server_disconnect
@@ -456,6 +464,18 @@ class NodeManager:
             try:
                 ent["producer"].close()
             except Exception:
+                pass
+        # Worker completion segments: the workers just got SIGKILLed
+        # above, so their own close never ran — unlink every file still
+        # registered (idempotent vs driver force-unlink).
+        with self._lock:
+            seg_paths = [p for paths in self._worker_segments.values()
+                         for p in paths]
+            self._worker_segments.clear()
+        for p in seg_paths:
+            try:
+                os.unlink(p)
+            except OSError:
                 pass
         self.server.close()
         try:
@@ -1127,6 +1147,20 @@ class NodeManager:
         return handle
 
     def _on_server_disconnect(self, conn: protocol.Conn):
+        # Worker completion segments (ISSUE 17): whatever this conn
+        # registered and never detached is a crash leftover — the
+        # worker's own close and the driver's force-unlink both remove
+        # the file when they run, so this unlink is the backstop for a
+        # worker killed between creating the file and either of those
+        # (idempotent: ENOENT ignored).
+        with self._lock:
+            seg_paths = self._worker_segments.pop(conn, None)
+        if seg_paths:
+            for p in seg_paths:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
         wid = conn.meta.get("worker_id")
         if wid is None:
             # A caller conn: release its local grants and reclaim any
@@ -2160,6 +2194,34 @@ class NodeManager:
                 # (the GCS copy feeds the timeline; this one feeds
                 # postmortems).
                 self.agent.record_task_events(payload or [])
+            elif mtype == "task_events_b":
+                # Blob-framed variant (ISSUE 17): the worker ships ONE
+                # pre-pickled batch; we unpickle for the local flight
+                # recorder and relay the blob to the GCS timeline
+                # verbatim — one worker _send serves both sinks.
+                try:
+                    events = pickle.loads(payload)
+                except Exception:
+                    events = []
+                if events:
+                    self.agent.record_task_events(events)
+                    try:
+                        self.gcs.notify("task_events_b", payload)
+                    except Exception:
+                        pass
+            elif mtype == "worker_segment_attached":
+                # Crash-cleanup registry for worker completion segment
+                # files (see _on_server_disconnect).
+                with self._lock:
+                    self._worker_segments.setdefault(conn, set()).add(
+                        payload["path"])
+            elif mtype == "worker_segment_detached":
+                with self._lock:
+                    segs = self._worker_segments.get(conn)
+                    if segs is not None:
+                        segs.discard(payload["path"])
+                        if not segs:
+                            self._worker_segments.pop(conn, None)
             elif mtype in ("collect_stacks", "agent_logs",
                            "flight_snapshot", "flight_dump", "profile"):
                 # The agent endpoint is also directly addressable on the

@@ -52,6 +52,18 @@ ACTOR_PLACED = "actor_placed"                  # NM -> GCS (notify)
 # own node manager (the submit ring's return-path twin).
 LEASE_TASKS_DONE_B = "lease_tasks_done_b"      # worker -> caller (notify)
 REGISTER_COMPLETION_RING = "register_completion_ring"  # driver -> NM (request)
+# Worker->driver shm completion segments (ISSUE 17): the driver
+# advertises its completion ring over the lease conn at grant time; the
+# worker creates a per-worker segment beside it and answers with the
+# segment path; the driver maps it and acks — only then does the worker
+# arm the segment (socket fallback until, and whenever the segment is
+# full / the driver's heartbeat goes stale). The worker also mirrors
+# attach/detach to its NM, whose registry reaps segment files a
+# SIGKILLed worker (or a vanished driver) left behind.
+ATTACH_COMPLETION_RING = "attach_completion_ring"        # caller -> worker
+ATTACH_COMPLETION_SEGMENT = "attach_completion_segment"  # worker -> caller
+ATTACH_COMPLETION_SEGMENT_ACK = \
+    "attach_completion_segment_ack"                      # caller -> worker
 
 
 class ConnectionClosed(Exception):

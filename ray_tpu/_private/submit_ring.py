@@ -9,315 +9,45 @@ to the GCS in ``submit_task_batch`` frames (it never unpickles them).
 The arena-slab store proved the mmap substrate in PR 2; this is the
 control-plane twin.
 
-Doorbell discipline (futex-style): while the consumer is actively
-draining, a producer append is pure memcpy + one 8-byte tail publish —
-no syscall. Only when the consumer has parked itself (flag in the
-header) does the producer poke a tiny AF_UNIX datagram doorbell. The
-consumer's park is additionally bounded (100 ms recv timeout) so the
-classic parked-flag/tail store-load race (x86 TSO gives no store-load
-ordering) costs at worst one bounded timeout, never a lost wakeup.
+The ring substrate itself (layout, publication protocol, doorbell
+discipline, liveness rules, memory-model caveats) lives in
+``shm_ring`` — this module only binds the submit-transport roles:
 
-Failure containment:
-- ring full -> the producer declines (caller falls back to the socket
+- the DRIVER creates the file and dials the doorbell (RingWriter); it
+  arms only after the NM acks registration (``active``);
+- the NM maps the existing file, owns the doorbell, and beats the
+  heartbeat (RingReader);
+- ring full -> the writer declines (caller falls back to the socket
   batch path; driver_submit_ring_full_total counts it);
-- NM death  -> the consumer heartbeat in the header goes stale; the
-  driver recovers every unconsumed record and resubmits it over the
-  socket. The consumer advances the head only AFTER its GCS relay
-  returns, so recovery is at-least-once — the GCS submit-batch handler
-  dedups on task id (specs are retained by id at submit).
-
-Layout (offsets in bytes; all fields little-endian u64 unless noted):
-    0   magic "RTSUBMR1"
-    8   data capacity
-    16  tail (producer cursor, monotonically increasing)
-    24  head (consumer cursor)
-    32  consumer parked flag
-    40  producer closed flag
-    48  consumer heartbeat (f64 CLOCK_MONOTONIC seconds)
-    64  data region (byte ring of [u32 length][payload] records)
-
-Single-producer is enforced driver-side with a lock (submissions can
-come from any user thread); single-consumer is the NM's one drain
-thread per ring. 8-byte header stores are aligned single memcpys.
-Memory model: the payload-before-tail publication depends on
-STORE-STORE ordering, which pure-Python mmap writes cannot fence —
-x86-64 TSO provides it; weaker models (arm64) do not, so the lease
-manager only enables the ring on x86-64.
+- NM death  -> the consumer heartbeat goes stale; the driver recovers
+  every unconsumed record (``recover_unconsumed``) and resubmits it
+  over the socket. The consumer advances the head only AFTER its GCS
+  relay returns, so recovery is at-least-once — the GCS submit-batch
+  handler dedups on task id (specs are retained by id at submit);
+- teardown    -> the writer created the file, so its close() unlinks
+  it; the reader's close() only unlinks the bell it bound.
 """
 
 from __future__ import annotations
 
-import mmap
-import os
-import socket
-import struct
-import threading
-import time
-from typing import List, Optional, Tuple
+from ray_tpu._private import shm_ring
 
 MAGIC = b"RTSUBMR1"
-HDR_SIZE = 64
-_OFF_CAPACITY = 8
-_OFF_TAIL = 16
-_OFF_HEAD = 24
-_OFF_PARKED = 32
-_OFF_CLOSED = 40
-_OFF_BEAT = 48
-
-_U64 = struct.Struct("<Q")
-_F64 = struct.Struct("<d")
-_LEN = struct.Struct("<I")
-
-# Consumer park bound: also the worst-case delivery delay added by the
-# parked-flag/tail publication race (no cross-process fence in pure
-# Python; see module docstring).
-PARK_TIMEOUT_S = 0.1
+HDR_SIZE = shm_ring.HDR_SIZE
+PARK_TIMEOUT_S = shm_ring.PARK_TIMEOUT_S
 
 
-class _Mapped:
-    """Shared mmap plumbing for both ends."""
-
-    def __init__(self, path: str, create: bool, capacity: int = 0):
-        self.path = path
-        if create:
-            fd = os.open(path, os.O_CREAT | os.O_TRUNC | os.O_RDWR, 0o600)
-            try:
-                os.ftruncate(fd, HDR_SIZE + capacity)
-                self._mm = mmap.mmap(fd, HDR_SIZE + capacity)
-            finally:
-                os.close(fd)
-            self._mm[0:8] = MAGIC
-            self._mm[_OFF_CAPACITY:_OFF_CAPACITY + 8] = _U64.pack(capacity)
-            self.capacity = capacity
-        else:
-            fd = os.open(path, os.O_RDWR)
-            try:
-                size = os.fstat(fd).st_size
-                self._mm = mmap.mmap(fd, size)
-            finally:
-                os.close(fd)
-            if self._mm[0:8] != MAGIC:
-                self._mm.close()
-                raise ValueError(f"not a submit ring: {path}")
-            self.capacity = _U64.unpack(
-                self._mm[_OFF_CAPACITY:_OFF_CAPACITY + 8])[0]
-
-    def _get(self, off: int) -> int:
-        return _U64.unpack_from(self._mm, off)[0]
-
-    def _put(self, off: int, val: int) -> None:
-        _U64.pack_into(self._mm, off, val)
-
-    def _read_data(self, pos: int, n: int) -> bytes:
-        """Wrap-aware read of n bytes at ring position pos."""
-        cap = self.capacity
-        i = pos % cap
-        if i + n <= cap:
-            return bytes(self._mm[HDR_SIZE + i:HDR_SIZE + i + n])
-        first = cap - i
-        return bytes(self._mm[HDR_SIZE + i:HDR_SIZE + cap]) + \
-            bytes(self._mm[HDR_SIZE:HDR_SIZE + n - first])
-
-    def _write_data(self, pos: int, data: bytes) -> None:
-        cap = self.capacity
-        i = pos % cap
-        n = len(data)
-        if i + n <= cap:
-            self._mm[HDR_SIZE + i:HDR_SIZE + i + n] = data
-        else:
-            first = cap - i
-            self._mm[HDR_SIZE + i:HDR_SIZE + cap] = data[:first]
-            self._mm[HDR_SIZE:HDR_SIZE + n - first] = data[first:]
-
-    def close_map(self) -> None:
-        try:
-            self._mm.close()
-        except (BufferError, ValueError):
-            pass
-
-
-class RingWriter(_Mapped):
-    """Driver side: creates the ring file + dials the doorbell."""
-
-    # Bell sends are rate-limited: under a sustained flood the consumer
-    # re-parks between GIL slices and a naive producer would pay one
-    # syscall per append (~9% of the submit hot path in the r09
-    # profile). Suppression only applies under a deep backlog (see
-    # append), where the flood's next append past the window rings; a
-    # burst's final records always ring, so no record waits out the
-    # bounded park for lack of a bell.
-    BELL_MIN_INTERVAL_S = 0.005
+class RingWriter(shm_ring.Producer):
+    """Driver side: creates the ring file + dials the doorbell.
+    Declines every append until registration is acked (``active``)."""
 
     def __init__(self, path: str, capacity: int):
-        super().__init__(path, create=True, capacity=capacity)
-        self._tail = 0
-        self._lock = threading.Lock()   # submissions come from any thread
-        self._bell: Optional[socket.socket] = None
-        self._last_bell = 0.0
-        self.active = False   # set once the NM acked registration
-        self.dead = False
-
-    def connect_bell(self) -> None:
-        s = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
-        s.setblocking(False)
-        s.connect(self.path + ".bell")
-        self._bell = s
-
-    def append(self, blob: bytes) -> bool:
-        """One record in, or False on ring-full / dead ring."""
-        n = _LEN.size + len(blob)
-        with self._lock:
-            if self.dead or not self.active:
-                return False
-            head = self._get(_OFF_HEAD)
-            if self.capacity - (self._tail - head) < n:
-                return False
-            self._write_data(self._tail, _LEN.pack(len(blob)) + blob)
-            # Publish AFTER the payload bytes: the consumer loads tail
-            # first, so it can never read an unwritten record.
-            self._tail += n
-            self._put(_OFF_TAIL, self._tail)
-            parked = self._get(_OFF_PARKED)
-            backlog = self._tail - head
-        if parked:
-            # Rate-limit only under a DEEP backlog (a flood guarantees
-            # more appends, one of which passes the window). A shallow
-            # backlog may be the last record of a burst — suppressing
-            # its bell would strand it for the full bounded park.
-            now = time.monotonic()
-            if backlog <= 4096 \
-                    or now - self._last_bell >= self.BELL_MIN_INTERVAL_S:
-                self._last_bell = now
-                self._ring_bell()
-        return True
-
-    def _ring_bell(self) -> None:
-        s = self._bell
-        if s is None:
-            return
-        try:
-            s.send(b"!")
-        except (BlockingIOError, OSError):
-            pass   # a wakeup is already pending, or the reader is gone
-        # (either way the bounded park covers it)
-
-    def consumer_stale(self, budget_s: float) -> bool:
-        """True when records are pending but the consumer heartbeat has
-        not moved for budget_s — the NM (or its drain thread) is gone."""
-        if self.dead or not self.active:
-            return False
-        with self._lock:
-            pending = self._tail > self._get(_OFF_HEAD)
-        if not pending:
-            return False
-        beat = _F64.unpack_from(self._mm, _OFF_BEAT)[0]
-        return (time.monotonic() - beat) > budget_s
-
-    def recover_unconsumed(self) -> List[bytes]:
-        """Mark the ring dead and return every record past the consumer
-        head, for resubmission over the socket path."""
-        out: List[bytes] = []
-        with self._lock:
-            self.dead = True
-            pos = self._get(_OFF_HEAD)
-            while pos < self._tail:
-                (n,) = _LEN.unpack(self._read_data(pos, _LEN.size))
-                out.append(self._read_data(pos + _LEN.size, n))
-                pos += _LEN.size + n
-        return out
-
-    def close(self) -> None:
-        with self._lock:
-            self.dead = True
-            try:
-                self._put(_OFF_CLOSED, 1)
-            except (ValueError, IndexError):
-                pass
-        self._ring_bell()   # wake the consumer so it observes closed
-        if self._bell is not None:
-            try:
-                self._bell.close()
-            except OSError:
-                pass
-        self.close_map()
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
+        super().__init__(path, MAGIC, create=True, capacity=capacity,
+                         active=False, kind="submit ring")
 
 
-class RingReader(_Mapped):
+class RingReader(shm_ring.Consumer):
     """NM side: maps an existing ring, owns the doorbell socket."""
 
     def __init__(self, path: str):
-        super().__init__(path, create=False)
-        self._head = self._get(_OFF_HEAD)
-        self._bell = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
-        try:
-            os.unlink(path + ".bell")
-        except FileNotFoundError:
-            pass
-        self._bell.bind(path + ".bell")
-        self._bell.settimeout(PARK_TIMEOUT_S)
-        self.stopped = False
-        # First heartbeat at map time: the writer's staleness check must
-        # not see a zero beat between registration and the drain
-        # thread's first loop.
-        self.beat()
-
-    def beat(self) -> None:
-        _F64.pack_into(self._mm, _OFF_BEAT, time.monotonic())
-
-    def producer_closed(self) -> bool:
-        return bool(self._get(_OFF_CLOSED))
-
-    def drain(self, max_records: int = 512) -> Tuple[List[bytes], int]:
-        """Read up to max_records pending records WITHOUT advancing the
-        shared head. Returns (blobs, new_head); the caller commits the
-        head only after the records are safely relayed (at-least-once)."""
-        tail = self._get(_OFF_TAIL)
-        pos = self._head
-        out: List[bytes] = []
-        while pos < tail and len(out) < max_records:
-            (n,) = _LEN.unpack(self._read_data(pos, _LEN.size))
-            out.append(self._read_data(pos + _LEN.size, n))
-            pos += _LEN.size + n
-        return out, pos
-
-    def commit(self, new_head: int) -> None:
-        self._head = new_head
-        self._put(_OFF_HEAD, new_head)
-
-    def park_wait(self) -> None:
-        """Park until the producer rings the bell (bounded; see
-        PARK_TIMEOUT_S). Caller re-checks the ring either way."""
-        self._put(_OFF_PARKED, 1)
-        try:
-            # Lost-wakeup guard: a record published between our last
-            # drain and the flag store is caught by this re-check; the
-            # bounded recv covers the symmetric store-load race.
-            if self._get(_OFF_TAIL) > self._head:
-                return
-            try:
-                # raylint: disable-next=unbounded-wait (bounded: the
-                # socket carries a PARK_TIMEOUT_S settimeout set at
-                # construction)
-                self._bell.recv(64)
-            except socket.timeout:
-                pass
-            except OSError:
-                time.sleep(PARK_TIMEOUT_S)
-        finally:
-            self._put(_OFF_PARKED, 0)
-
-    def close(self) -> None:
-        self.stopped = True
-        try:
-            self._bell.close()
-        except OSError:
-            pass
-        try:
-            os.unlink(self.path + ".bell")
-        except OSError:
-            pass
-        self.close_map()
+        super().__init__(path, MAGIC, kind="submit ring")

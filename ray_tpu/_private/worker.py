@@ -48,12 +48,29 @@ from ray_tpu._private.task_spec import (
     normalize_resources,
 )
 from ray_tpu.object_store import plasma
+from ray_tpu.util import metrics as metrics_util
 
 import logging
 
 logger = logging.getLogger("ray_tpu.worker")
 
 _INLINE_ARG_LIMIT = 512 * 1024  # larger arg blobs go through the object store
+
+
+def _build_ring_metrics():
+    """Driver-side worker-segment metrics (lazy: only a driver whose
+    consumer loop drains attached segments ever builds them)."""
+    from ray_tpu.util import metrics
+
+    depth = metrics.Gauge(
+        "worker_completion_segment_depth",
+        "Deepest per-producer backlog in bytes across this driver's "
+        "attached worker completion segments, sampled at each consumer "
+        "drain pass")
+    return (depth,)
+
+
+_ring_metrics = metrics_util.lazy_metrics(_build_ring_metrics)
 
 
 class ObjectRef:
@@ -614,6 +631,17 @@ class CoreWorker:
             role == "driver"
             and bool(_cfg.completion_ring_enabled)
             and _platform.machine() in ("x86_64", "AMD64"))
+        # Worker completion segments (ISSUE 17): per-worker SPSC
+        # segments beside the main ring, attached over the lease conn
+        # after we advertise the ring, drained by the same consumer
+        # thread. path -> {"seg": SegmentConsumer, "conn": lease conn,
+        # "closing": bool}; only the consumer thread ever drains or
+        # closes a segment (single-consumer) — other threads just flag
+        # "closing" under _comp_ring_lock.
+        self._comp_segments: Dict[str, Dict[str, Any]] = {}
+        self._worker_ring_enabled = (
+            self._comp_ring_enabled
+            and bool(_cfg.worker_completion_ring_enabled))
         # Workers get theirs lazily, on their first task submission:
         # LeaseManager construction costs a nodes() RPC + an NM pre-dial
         # + a flusher thread, and most actor/task workers never submit —
@@ -1674,6 +1702,13 @@ class CoreWorker:
                                  daemon=True, name="rtpu-completion-ring")
             self._comp_ring_thread = t
             t.start()
+            if self._worker_ring_enabled:
+                # Leases installed before the ring went live never saw
+                # an advertisement — cover them now (the install path
+                # covers every lease granted from here on).
+                lm = self._lease_mgr
+                if lm is not None:
+                    lm.advertise_worker_ring()
         except Exception:
             self._comp_ring_state = 3
             if ring is not None:
@@ -1696,9 +1731,17 @@ class CoreWorker:
         try:
             while not self._closed and not ring.stopped:
                 ring.beat()
+                with self._comp_ring_lock:
+                    ents = list(self._comp_segments.values())
+                for ent in ents:
+                    # Per-segment heartbeat: the worker producer's
+                    # staleness check watches ITS segment, not the
+                    # main ring.
+                    ent["seg"].beat()
                 if self._comp_ring_pause:   # test seam: stop consuming
                     time.sleep(0.02)
                     continue
+                progressed = False
                 blobs, new_head = ring.drain(256)
                 if blobs:
                     for blob in blobs:
@@ -1707,15 +1750,147 @@ class CoreWorker:
                         except Exception:
                             pass   # corrupt record: the GCS copy owns it
                     ring.commit(new_head)
+                    progressed = True
+                if ents:
+                    progressed |= self._drain_worker_segments(ents)
+                if progressed:
                     continue
-                if ring.producer_closed():
+                if ring.producer_closed() and not ents:
                     break
-                ring.park_wait()
+                # Shared park: flag every segment parked so its worker
+                # knows to ring OUR bell, re-check them (lost-wakeup
+                # guard), then park on the main ring's doorbell. The
+                # residual flag/publish race costs at worst one bounded
+                # PARK_TIMEOUT_S, same as the main ring's. The drain
+                # pass above may have detached+closed some of this
+                # snapshot's segments (worker exit): skip those — their
+                # mmap is gone.
+                live = [e for e in ents if not e["seg"].stopped]
+                for ent in live:
+                    ent["seg"].set_parked(True)
+                try:
+                    if not any(e["seg"].pending() for e in live):
+                        ring.park_wait()
+                finally:
+                    for ent in live:
+                        ent["seg"].set_parked(False)
         finally:
+            with self._comp_ring_lock:
+                ents = list(self._comp_segments.values())
+                self._comp_segments.clear()
+            for ent in ents:
+                try:
+                    ent["seg"].close(unlink=True)
+                except Exception:
+                    pass
             try:
                 ring.close()
             except Exception:
                 pass
+            # Orphan sweep: a worker SIGKILLed between creating its
+            # segment file and the driver mapping it leaves a file no
+            # registry entry points at. Every segment is namespaced
+            # under OUR ring path, so the glob is exact.
+            import glob as _glob
+
+            for p in _glob.glob(ring.path + ".w*"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def _drain_worker_segments(self, ents) -> bool:
+        """One drain pass over the attached worker segments (consumer
+        thread only). Returns True if any segment yielded records.
+        A closed-and-drained segment (graceful worker exit) or one
+        flagged closing (lease conn died) detaches here — the single
+        consumer doing every close keeps the SPSC contract."""
+        progressed = False
+        depth = 0
+        for ent in ents:
+            seg = ent["seg"]
+            try:
+                depth = max(depth, seg.backlog_bytes())
+                blobs, new_head = seg.drain(256)
+            except Exception:
+                blobs, new_head = [], None
+                ent["closing"] = True
+            if blobs:
+                lm = self._lease_mgr
+                if lm is not None:
+                    lm.ring_absorb(blobs)
+                seg.commit(new_head)
+                progressed = True
+            elif ent["closing"] or seg.producer_closed():
+                # Drained dry and the producer is gone (or its lease
+                # conn is): detach. Force-unlink — the worker may have
+                # died without its close() running.
+                with self._comp_ring_lock:
+                    self._comp_segments.pop(seg.path, None)
+                try:
+                    seg.close(unlink=True)
+                except Exception:
+                    pass
+        try:
+            _ring_metrics()[0].set(depth)
+        except Exception:
+            pass
+        return progressed
+
+    def _attach_worker_segment(self, path: str, conn) -> None:
+        """A same-node leased worker answered our ring advertisement
+        with its freshly-created segment: map it, register it with the
+        consumer loop, and ack so the worker arms its producer. Runs on
+        the lease conn's serve thread (mapping is microseconds). No ack
+        on any failure — the worker then simply keeps the socket path."""
+        from ray_tpu._private import completion_ring
+
+        ring = self._comp_ring
+        if (ring is None or not self._worker_ring_enabled
+                or self._closed or ring.stopped):
+            return
+        if not path.startswith(ring.path + ".w"):
+            return   # not a segment of OUR ring: refuse to map it
+        try:
+            seg = completion_ring.SegmentConsumer(path)
+        except Exception:
+            return
+        with self._comp_ring_lock:
+            if self._closed or ring.stopped \
+                    or path in self._comp_segments:
+                dup = True
+            else:
+                dup = False
+                self._comp_segments[path] = {
+                    "seg": seg, "conn": conn, "closing": False}
+        if dup:
+            seg.close()
+            return
+        try:
+            conn.notify(protocol.ATTACH_COMPLETION_SEGMENT_ACK,
+                        {"path": path})
+        except protocol.ConnectionClosed:
+            with self._comp_ring_lock:
+                self._comp_segments.pop(path, None)
+            seg.close(unlink=True)
+
+    def _detach_worker_segments(self, conn) -> None:
+        """Lease conn died (worker exit, SIGKILL, or lease drop): flag
+        its segments closing. The consumer loop finishes draining any
+        published records on its next pass — at-least-once for results
+        that beat the death — then closes and force-unlinks."""
+        with self._comp_ring_lock:
+            for ent in self._comp_segments.values():
+                if ent["conn"] is conn:
+                    ent["closing"] = True
+
+    def _has_segments_for_conn(self, conn) -> bool:
+        """True while the consumer loop still holds segments attached
+        over this conn (the lease failure path waits a bounded moment
+        for their final drain before failing in-flight specs)."""
+        with self._comp_ring_lock:
+            return any(ent["conn"] is conn
+                       for ent in self._comp_segments.values())
 
     def _absorb_completion_record(self, blob: bytes) -> None:
         """Apply one NM-relayed completion record locally: inline blobs

@@ -49,7 +49,15 @@ def _build_worker_metrics():
         "worker_inline_returns_total",
         "Task returns shipped in-band inside the completion "
         "message (zero object-store touches)")
-    return (inline_total,)
+    ring_appends = metrics.Counter(
+        "worker_completion_ring_appends_total",
+        "Lease completions appended to a same-node driver's shm "
+        "completion segment (no socket send on the return path)")
+    ring_full = metrics.Counter(
+        "worker_completion_ring_full_total",
+        "Lease completions that fell back to the socket because the "
+        "shm completion segment was full (or mid-teardown)")
+    return (inline_total, ring_appends, ring_full)
 
 
 _worker_metrics = metrics_util.lazy_metrics(_build_worker_metrics)
@@ -95,6 +103,23 @@ class WorkerExecutor:
         # twin of lease_run_tasks_b) so the driver's conn thread only
         # parks raw bytes and the absorb executor unpickles off-thread.
         self._absorb_b = bool(config.completion_absorb_enabled)
+        # Worker->driver shm completion segments (ISSUE 17): when a
+        # same-node lease holder advertises its completion ring, we
+        # create a per-conn SPSC segment next to it and append lease
+        # completions there instead of notifying over the conn. The
+        # socket stays as the fallback for cross-node holders, a full
+        # segment, a failed attach, or the knob being off anywhere.
+        # x86-64 only: payload-before-tail publication relies on TSO
+        # store-store ordering (see shm_ring).
+        import platform
+
+        self._worker_ring_on = (
+            bool(config.worker_completion_ring_enabled)
+            and platform.machine() in ("x86_64", "AMD64"))
+        self._seg_bytes = int(config.worker_completion_ring_bytes)
+        self._comp_producers: Dict[Any, Any] = {}   # lease conn -> producer
+        self._prod_lock = threading.Lock()
+        self._seg_seq = 0
         # Unified completion buffer: (conn_or_None, record) — None routes
         # to the NM as a task_done_batch frame (classic path), a conn is
         # a lease holder's direct connection (lease_tasks_done). One
@@ -260,6 +285,10 @@ class WorkerExecutor:
                 self._cv.notify()
         elif mtype == "cancel_task":
             self._handle_cancel(payload["task_id"])
+        elif mtype == protocol.ATTACH_COMPLETION_RING:
+            self._attach_completion_ring(conn, payload)
+        elif mtype == protocol.ATTACH_COMPLETION_SEGMENT_ACK:
+            self._arm_completion_segment(conn, payload)
         elif mtype == "ping":
             conn.reply(msg_id, True)
 
@@ -309,8 +338,115 @@ class WorkerExecutor:
             except protocol.ConnectionClosed:
                 pass
 
+    def _attach_completion_ring(self, conn, payload):
+        """A same-node lease holder advertised its completion ring:
+        create our per-conn segment next to it, dial the SHARED bell,
+        and answer with the segment path. The producer stays inactive
+        (every append declines to the socket) until the driver maps the
+        segment and acks — so a record can never strand in a file no
+        consumer will ever read."""
+        from ray_tpu._private import completion_ring
+
+        if not self._worker_ring_on:
+            return
+        if payload.get("node_id") != self.node_id:
+            return   # cross-node advert (config confusion): mmap is local
+        with self._prod_lock:
+            if conn in self._comp_producers:
+                return   # repeat advert (ring restart churn): keep ours
+            self._seg_seq += 1
+            seq = self._seg_seq
+        base = payload["path"]
+        path = f"{base}.w{os.getpid():x}_{seq}"
+        try:
+            prod = completion_ring.SegmentProducer(
+                path, self._seg_bytes, bell_path=base + ".bell")
+            prod.connect_bell()
+        except Exception:
+            # Can't create/dial (driver tearing down, FS oddity): the
+            # socket path simply keeps carrying this conn's results.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return
+        with self._prod_lock:
+            if conn.closed or conn in self._comp_producers:
+                stale = True
+            else:
+                stale = False
+                self._comp_producers[conn] = prod
+        if stale:
+            prod.close()   # unlinks our own segment file
+            return
+        try:
+            conn.notify(protocol.ATTACH_COMPLETION_SEGMENT, {"path": path})
+        except protocol.ConnectionClosed:
+            self._drop_producer(conn)
+            return
+        # Register with the NM: if we are SIGKILLed the NM unlinks the
+        # segment file (the driver's force-unlink on detach covers the
+        # mapped case; this covers died-before-the-driver-mapped).
+        try:
+            self.nm.notify("worker_segment_attached", {"path": path})
+        except protocol.ConnectionClosed:
+            os._exit(0)
+
+    def _arm_completion_segment(self, conn, payload):
+        """Driver mapped our segment and acked: arm the producer. From
+        here every lease completion for this conn tries the segment
+        first."""
+        with self._prod_lock:
+            prod = self._comp_producers.get(conn)
+        if prod is not None and prod.path == payload.get("path"):
+            prod.active = True
+
+    def _drop_producer(self, conn):
+        """Tear down this conn's segment producer (conn closed, driver
+        heartbeat stale, or worker exit): flag the segment closed so
+        the driver's consumer detaches after its final drain, unlink
+        our file (idempotent vs the driver's force-unlink), and tell
+        the NM to forget the crash-cleanup entry."""
+        with self._prod_lock:
+            prod = self._comp_producers.pop(conn, None)
+        if prod is None:
+            return
+        path = prod.path
+        try:
+            prod.close()
+        except Exception:
+            pass
+        try:
+            self.nm.notify("worker_segment_detached", {"path": path})
+        except protocol.ConnectionClosed:
+            pass
+
+    _SEG_STALE_S = 5.0
+
+    def _check_producers(self):
+        """Liveness backstop, polled from the event-flush loop: a
+        driver that stopped beating its consumer heartbeat while we
+        hold published records is wedged or dead — tear the segment
+        down and let the socket path (and the lease conn's own death)
+        take over."""
+        if not self._comp_producers:
+            return
+        with self._prod_lock:
+            items = list(self._comp_producers.items())
+        for conn, prod in items:
+            try:
+                stale = prod.consumer_stale(self._SEG_STALE_S)
+            except Exception:
+                stale = True
+            if stale or conn.closed:
+                self._drop_producer(conn)
+
     def _on_direct_disconnect(self, conn):
-        # The lease holder hung up. Only tell the NM when NO direct conn
+        # The lease holder hung up: its segment producer goes first
+        # (close flags the segment so the driver-side consumer detaches
+        # after a final drain).
+        self._drop_producer(conn)
+        # Only tell the NM when NO direct conn
         # remains (on either listener): a stale old-holder conn closing
         # while the new holder is connected must not release the new
         # holder's lease.
@@ -670,6 +806,30 @@ class WorkerExecutor:
             except Exception:
                 pass
         for conn, results in by_conn.items():
+            # Shm fast path (ISSUE 17): a same-node holder with an
+            # armed segment gets its records as in-place appends — no
+            # socket send at all. Records the segment declines (full,
+            # not yet acked, tearing down) fall through to the socket
+            # notify below; the driver-side absorb is idempotent, so
+            # the split delivery is safe in any interleaving.
+            prod = (self._comp_producers.get(conn)
+                    if self._comp_producers else None)
+            if prod is not None and prod.active and not prod.dead:
+                rest = []
+                for r in results:
+                    if not prod.append(pickle.dumps(r, protocol=5)):
+                        rest.append(r)
+                appended = len(results) - len(rest)
+                try:
+                    if appended:
+                        _worker_metrics()[1].inc(appended)
+                    if rest:
+                        _worker_metrics()[2].inc(len(rest))
+                except Exception:
+                    pass
+                if not rest:
+                    continue
+                results = rest
             try:
                 if self._absorb_b:
                     conn.notify(protocol.LEASE_TASKS_DONE_B, [
@@ -810,6 +970,12 @@ class WorkerExecutor:
     def _delayed_exit(self):
         time.sleep(0.1)
         self._flush_completions()
+        # Close segment producers AFTER the last flush appended into
+        # them: the closed flag tells the driver's consumer "drain what
+        # is there, then detach" — results published right before this
+        # exit still resolve without re-running.
+        for conn in list(self._comp_producers):
+            self._drop_producer(conn)
         self.nm.flush()
         os._exit(0)
 
@@ -949,32 +1115,48 @@ class WorkerExecutor:
         with self._event_lock:
             self._event_buf.append(ev)
 
+    # Event pacing: telemetry tolerates ~1s of latency, and the r12
+    # worker profile showed the old per-0.2s-tick double notify (GCS +
+    # NM) as a standing _send tower on the rtpu-task-events thread at
+    # high task rates. Size cap keeps a flood's frames bounded.
+    _EVENT_FLUSH_S = 1.0
+    _EVENT_BATCH = 256
+
     def _event_flush_loop(self):
+        last_ev = time.monotonic()
         while not self._event_stop.wait(0.2):
             # Safety-net completion flush: queue-empty/size triggers
             # cover the main loop, but actor thread-pool / asyncio
             # completions can land while the main queue is busy.
+            # (Completions keep the tight 0.2s tick — they gate caller
+            # ray.get()s; events are telemetry and flush ~1/s.)
             if self._completions:
                 try:
                     self._flush_completions()
                 except Exception:
                     pass
-            self._flush_events()
+            self._check_producers()
+            now = time.monotonic()
+            with self._event_lock:
+                n = len(self._event_buf)
+            if n and (n >= self._EVENT_BATCH
+                      or now - last_ev >= self._EVENT_FLUSH_S):
+                last_ev = now
+                self._flush_events()
 
     def _flush_events(self):
+        """Ship buffered task/span events as ONE pre-pickled blob to
+        the NM, which feeds its agent's flight recorder and relays the
+        same blob to the GCS timeline — one _send on this thread per
+        flush window instead of the old two (GCS + NM) with the batch
+        re-pickled for each."""
         with self._event_lock:
             batch, self._event_buf = self._event_buf, []
         if not batch:
             return
         try:
-            self.core.gcs.notify("task_events", batch)
-        except Exception:
-            pass
-        # Mirror to the node agent's flight recorder: the postmortem of
-        # a slice death needs this node's last events locally, with no
-        # dependency on the GCS being reachable at dump time.
-        try:
-            self.nm.notify("task_events", batch)
+            self.nm.notify("task_events_b",
+                           pickle.dumps(batch, protocol=5))
         except Exception:
             pass
 
@@ -1045,6 +1227,10 @@ def main():
             executor._flush_completions()
         except Exception:
             pass
+        # Segment producers close after the final flush: the closed
+        # flag lets the driver drain the last records, then detach.
+        for conn in list(executor._comp_producers):
+            executor._drop_producer(conn)
         executor._flush_events()
         core.disconnect()
 

@@ -65,8 +65,12 @@ LOCKDEP_MODULES = {
     "test_inline_returns",
     # The completion-ingestion fast path adds the absorb executor, the
     # completion-ring producer lock (held on the NM's task_done path),
-    # and caller-thread steal-absorb to the lease/NM lock graph —
-    # witness the new edges where its tests drive them.
+    # caller-thread steal-absorb, and the worker-segment edges — the
+    # driver's _comp_ring_lock around the segment registry (taken from
+    # lease conn threads, the consumer loop, AND the lease failure
+    # path's bounded drain-wait) plus the worker's producer lock — to
+    # the lease/NM lock graph. Witness the edges where its tests drive
+    # them.
     "test_completion_fastpath",
 }
 

@@ -1,6 +1,8 @@
-"""Driver-side completion ingestion fast path (ISSUE 16 / SCALE_r10):
-absorb split off the lease conn thread, the shm completion ring, and
-parallel (work-stealing) wave collection.
+"""Driver-side completion ingestion fast path (ISSUE 16 / SCALE_r10)
+and the worker->driver shm completion segments (ISSUE 17): absorb
+split off the lease conn thread, the shm completion ring, parallel
+(work-stealing) wave collection, and same-node workers appending lease
+completions straight into per-worker segments of the driver's ring.
 
 The contract under test:
 
@@ -25,8 +27,10 @@ The contract under test:
   stall collection.
 """
 
+import glob
 import os
 import pickle
+import signal
 import threading
 import time
 
@@ -103,25 +107,31 @@ def _activate_ring(w):
 # --------------------------------------------- stage 1: absorb split
 
 
-def test_absorb_split_executes_identically(ray_cluster):
-    """Default knobs: frames park in the ingest deque and a dedicated
-    absorb thread (not the conn thread) unpickles them — and every
-    result comes back exactly as the classic path would deliver it."""
-    w = _worker()
-    lm = w._lease_mgr
-    assert lm is not None and lm._absorb_exec is not None
+def test_absorb_split_executes_identically():
+    """Socket framing path (worker segments pinned off so completions
+    arrive as lease_tasks_done_b frames): frames park in the ingest
+    deque and a dedicated absorb thread (not the conn thread)
+    unpickles them — and every result comes back exactly as the
+    classic path would deliver it."""
+    _cluster(worker_completion_ring_enabled=False)
+    try:
+        w = _worker()
+        lm = w._lease_mgr
+        assert lm is not None and lm._absorb_exec is not None
 
-    @ray_tpu.remote
-    def f(x):
-        return x * 2
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
 
-    assert ray_tpu.get([f.remote(i) for i in range(64)]) == [
-        i * 2 for i in range(64)]
-    # The executor actually ran (its worker thread only spawns on the
-    # first submitted frame) and drained everything it parked.
-    assert any(t.name.startswith("rtpu-completion-absorb")
-               for t in threading.enumerate())
-    assert len(lm._ingest) == 0
+        assert ray_tpu.get([f.remote(i) for i in range(64)]) == [
+            i * 2 for i in range(64)]
+        # The executor actually ran (its worker thread only spawns on
+        # the first submitted frame) and drained everything it parked.
+        assert any(t.name.startswith("rtpu-completion-absorb")
+                   for t in threading.enumerate())
+        assert len(lm._ingest) == 0
+    finally:
+        ray_tpu.shutdown()
 
 
 def test_absorb_disabled_classic_wire():
@@ -304,36 +314,42 @@ def test_ring_disabled_never_registers():
 # --------------------------------- stage 3: parallel wave collection
 
 
-def test_get_and_wait_steal_parked_frames(ray_cluster):
+def test_get_and_wait_steal_parked_frames():
     """With the absorb executor wedged (frames park but nothing drains
     them), a caller blocking on a lease completion steals the parked
     frame onto its OWN thread: get() returns the value and wait()
     reports readiness without the GCS round trip — neither idles on an
-    event only the dead executor would have set."""
-    w = _worker()
-    lm = w._lease_mgr
-    real_submit = lm._absorb_submit
-    lm._absorb_submit = lambda: None   # frames park; nothing drains
+    event only the dead executor would have set. (Worker segments
+    pinned off: the stall under test is the SOCKET frame path.)"""
+    _cluster(worker_completion_ring_enabled=False)
     try:
+        w = _worker()
+        lm = w._lease_mgr
+        real_submit = lm._absorb_submit
+        lm._absorb_submit = lambda: None   # frames park; nothing drains
+        try:
 
-        @ray_tpu.remote
-        def f(x):
-            return x + 100
+            @ray_tpu.remote
+            def f(x):
+                return x + 100
 
-        ref = f.remote(7)
-        lm.flush_sends()
-        _wait_for(lambda: len(lm._ingest) > 0, msg="parked frame")
-        assert ray_tpu.get(ref, timeout=15) == 107
-        assert len(lm._ingest) == 0   # the caller thread absorbed it
+            ref = f.remote(7)
+            lm.flush_sends()
+            _wait_for(lambda: len(lm._ingest) > 0, msg="parked frame")
+            assert ray_tpu.get(ref, timeout=15) == 107
+            assert len(lm._ingest) == 0  # the caller thread absorbed it
 
-        ref2 = f.remote(8)
-        lm.flush_sends()
-        _wait_for(lambda: len(lm._ingest) > 0, msg="second parked frame")
-        ready, rest = ray_tpu.wait([ref2], num_returns=1, timeout=15)
-        assert ready == [ref2] and not rest
-        assert ray_tpu.get(ref2, timeout=15) == 108
+            ref2 = f.remote(8)
+            lm.flush_sends()
+            _wait_for(lambda: len(lm._ingest) > 0,
+                      msg="second parked frame")
+            ready, rest = ray_tpu.wait([ref2], num_returns=1, timeout=15)
+            assert ready == [ref2] and not rest
+            assert ray_tpu.get(ref2, timeout=15) == 108
+        finally:
+            lm._absorb_submit = real_submit
     finally:
-        lm._absorb_submit = real_submit
+        ray_tpu.shutdown()
 
 
 def test_steal_disabled_gate():
@@ -352,5 +368,189 @@ def test_steal_disabled_gate():
 
         assert ray_tpu.get([f.remote(i) for i in range(16)]) == [
             i - 1 for i in range(16)]
+    finally:
+        ray_tpu.shutdown()
+
+
+# -------------------- stage 4: worker->driver segment transport
+
+
+def _activate_segment(w):
+    """Run lease traffic until the driver's ring is live AND at least
+    one same-node worker has attached its completion segment (the
+    advertise -> create -> map -> ack handshake is async with respect
+    to task completion, so poke until it lands)."""
+
+    @ray_tpu.remote
+    def _poke(x):
+        return x
+
+    assert ray_tpu.get(_poke.remote(1)) == 1
+    _wait_for(lambda: w._comp_ring_state in (2, 3), msg="ring registration")
+    assert w._comp_ring_state == 2, "ring registration failed"
+
+    def seg_live():
+        ray_tpu.get([_poke.remote(i) for i in range(4)])
+        return bool(w._comp_segments)
+
+    _wait_for(seg_live, timeout=20, msg="worker segment attach")
+
+
+def test_worker_segment_roundtrip(ray_cluster):
+    """Default knobs: same-node leased workers attach per-worker
+    segments under the driver's ring path and sustained lease traffic
+    flows through them with correct results; the segments drain to
+    empty when the wave completes."""
+    w = _worker()
+    _activate_segment(w)
+    ring_path = w._comp_ring.path
+    assert all(p.startswith(ring_path + ".w") for p in w._comp_segments)
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get([f.remote(i) for i in range(300)]) == [
+        i + 1 for i in range(300)]
+    # Wave done => every record was absorbed and committed.
+    _wait_for(lambda: all(not e["seg"].pending()
+                          for e in w._comp_segments.values()),
+              msg="segments drained")
+
+
+def test_worker_sigkill_midstream_no_loss_no_leak(ray_cluster):
+    """SIGKILL every leased worker mid-wave: records the workers
+    published before dying drain from their segments (tail publishes
+    after payload, so a torn append is invisible — never a corrupt
+    record), the unfinished remainder re-runs via the scheduled
+    fallback, and NO segment file outlives its worker (driver
+    force-unlink + NM registry backstop)."""
+    w = _worker()
+    _activate_segment(w)
+    ring_path = w._comp_ring.path
+    nm = _nm()
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 7
+
+    refs = [f.remote(i) for i in range(80)]
+    with nm._lock:
+        pids = [h.proc.pid for h in nm._workers.values()]
+    assert pids
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    # At-least-once: every result arrives (segment drain for completed
+    # records, scheduled re-run for the rest) and none is corrupt.
+    assert ray_tpu.get(refs, timeout=90) == [i * 7 for i in range(80)]
+    # The dead workers' segment files are gone (replacement workers may
+    # have attached fresh ones; those are live, not leaks).
+    _wait_for(lambda: set(glob.glob(ring_path + ".w*")) <=
+              set(w._comp_segments),
+              msg="dead-worker segment cleanup")
+
+
+def test_worker_segment_full_falls_back():
+    """A tiny segment + a stalled consumer: the worker fills the
+    segment, overflow records fall back to the socket
+    (lease_tasks_done_b), and when the consumer resumes the backlogged
+    ring records are redelivery-idempotent against the socket copies —
+    every result correct exactly once."""
+    _cluster(worker_completion_ring_bytes=4096)
+    try:
+        w = _worker()
+        _activate_segment(w)
+
+        @ray_tpu.remote
+        def f(x):
+            # ~1 KiB inlined record: THREE completions fill the 4 KiB
+            # segment regardless of pipeline depth, so the stall test
+            # never depends on how many tasks are in flight at once.
+            return (x, b"v" * 1024)
+
+        w._comp_ring_pause = True   # head stops: segment backlog grows
+        try:
+            refs = [f.remote(i) for i in range(150)]
+            # The segment actually filled (fallback engaged): with the
+            # consumer paused, published bytes approach the 4 KiB cap.
+            _wait_for(lambda: any(
+                e["seg"].backlog_bytes() > 2048
+                for e in w._comp_segments.values()),
+                msg="segment backlog under stall")
+        finally:
+            w._comp_ring_pause = False
+        assert ray_tpu.get(refs, timeout=60) == [
+            (i, b"v" * 1024) for i in range(150)]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_driver_shutdown_unlinks_segments():
+    """Driver shutdown with live worker producers: the consumer loop
+    force-unlinks every mapped segment and glob-sweeps the ring's
+    namespace — no comring_* file (main ring, bell, or segment)
+    survives the driver."""
+    _cluster()
+    try:
+        w = _worker()
+        _activate_segment(w)
+        ring_path = w._comp_ring.path
+        seg_paths = list(w._comp_segments)
+        assert seg_paths
+    finally:
+        ray_tpu.shutdown()
+    deadline = time.time() + 5
+    leftovers = lambda: ([p for p in seg_paths + [ring_path,
+                                                  ring_path + ".bell"]
+                          if os.path.exists(p)]
+                         + glob.glob(ring_path + ".w*"))
+    while time.time() < deadline and leftovers():
+        time.sleep(0.05)
+    assert not leftovers(), f"leaked shm files: {leftovers()}"
+
+
+def test_worker_ring_disabled_socket_only():
+    """worker_completion_ring_enabled=False: no segment ever attaches
+    (the driver never advertises) while the NM-relay main ring keeps
+    working — the socket carries every lease completion, results
+    identical."""
+    _cluster(worker_completion_ring_enabled=False)
+    try:
+        w = _worker()
+
+        @ray_tpu.remote
+        def f(x):
+            return x - 5
+
+        assert ray_tpu.get([f.remote(i) for i in range(64)]) == [
+            i - 5 for i in range(64)]
+        time.sleep(0.3)
+        assert not w._comp_segments
+        assert not w._worker_ring_enabled
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_worker_ring_without_main_ring():
+    """completion_ring_enabled=False with the worker knob on: there is
+    no driver ring for segments to attach next to, so the whole shm
+    family stays off and the socket path carries everything — knob
+    drift across the pair is safe in both directions."""
+    _cluster(completion_ring_enabled=False)
+    try:
+        w = _worker()
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 11
+
+        assert ray_tpu.get([f.remote(i) for i in range(64)]) == [
+            i * 11 for i in range(64)]
+        time.sleep(0.3)
+        assert w._comp_ring is None
+        assert not w._comp_segments
     finally:
         ray_tpu.shutdown()
