@@ -240,6 +240,7 @@ def run_engine_load(args):
     return {
         "metric": "llm_serve_engine",
         "mode": "continuous_batching",
+        "prefix_cache": bool(args.prefix_cache),
         "sessions": args.sessions,
         "requests": done_requests,
         "tokens_per_sec": round(total_tokens / wall, 1),
@@ -430,7 +431,9 @@ def run_handle_ab(args):
 # ----------------------------------------------------------- open loop
 
 
-def _proxy_port():
+def _proxy_ports(expect=1):
+    """All per-node ingress proxy ports (one proxy per cluster node;
+    ``--proxies N`` adds N-1 worker nodes so N proxies come up)."""
     import ray_tpu
     from ray_tpu.serve.api import _controller
 
@@ -438,10 +441,33 @@ def _proxy_port():
     while time.time() < deadline:
         ports = ray_tpu.get(_controller().proxy_addresses.remote(),
                             timeout=10)
-        if ports:
-            return next(iter(ports.values()))
+        if len(ports) >= expect:
+            return sorted(ports.values())
         time.sleep(0.3)
-    raise RuntimeError("ingress proxy never came up")
+    raise RuntimeError(f"{expect} ingress proxies never came up")
+
+
+def _tenant_prefix(tenant, n_tokens):
+    """The tenant's fixed shared prompt prefix (system-prompt stand-in):
+    deterministic per tenant, disjoint across tenants."""
+    rng = random.Random(f"prefix:{tenant}")
+    return [rng.randint(1, 30000) for _ in range(n_tokens)]
+
+
+def _engine_prefix_stats():
+    """Prefix-cache counters summed over the engine pool replicas."""
+    import ray_tpu
+
+    out = {"prefix_cache_hit_tokens": 0, "prefix_cache_lookup_tokens": 0,
+           "prefill_tokens_computed": 0}
+    try:
+        for rep in _pool_replicas(ENGINE_POOL):
+            st = ray_tpu.get(rep.stats.remote(), timeout=10)
+            for k in out:
+                out[k] += int(st.get(k) or 0)
+    except Exception:
+        pass
+    return out
 
 
 def _sse_request(port, payload, headers, rec):
@@ -504,9 +530,13 @@ def run_open_loop(args):
     TTFT + per-token latency of ADMITTED requests and the shed rate —
     the graceful-saturation curve (shed rises past the knee; admitted
     tail latency stays bounded; no collapse)."""
-    port = _proxy_port()
+    ports = _proxy_ports(expect=max(1, args.proxies))
     rng = random.Random(1234)
     tenants = [f"tenant{i}" for i in range(max(1, args.tenants))]
+    shared = args.workload == "shared-prefix"
+    prefixes = {t: _tenant_prefix(t, args.prefix_tokens)
+                for t in tenants} if shared else {}
+    prefix_stats_before = _engine_prefix_stats() if args.paged else {}
     rungs = []
     for rate in [float(r) for r in args.open_loop_rates.split(",")]:
         records = []
@@ -517,12 +547,17 @@ def run_open_loop(args):
             # Poisson arrivals: exponential inter-arrival gaps.
             time.sleep(rng.expovariate(rate))
             tenant = tenants[i % len(tenants)]
+            # Requests round-robin across every per-node proxy.
+            port = ports[i % len(ports)]
             i += 1
-            rec = {"tenant": tenant, "ttft": None}
+            rec = {"tenant": tenant, "proxy": port, "ttft": None}
             records.append(rec)
-            payload = {"model": "llm",
-                       "prompt": [rng.randint(1, 200) for _ in
-                                  range(rng.randint(4, 12))],
+            tail = [rng.randint(1, 200) for _ in
+                    range(rng.randint(4, 12))]
+            # shared-prefix: every request of a tenant opens with the
+            # tenant's fixed system prompt; only the tail is unique.
+            prompt = prefixes[tenant] + tail if shared else tail
+            payload = {"model": "llm", "prompt": prompt,
                        "max_tokens": args.new_tokens, "stream": True,
                        "seed": i}
             th = threading.Thread(
@@ -546,6 +581,19 @@ def run_open_loop(args):
                     [r["ttft"] for r in t_ok if r["ttft"]],
                     ps=(50, 95, 99)),
             }
+        per_proxy = {}
+        for p in ports:
+            p_ok = [r for r in ok if r["proxy"] == p]
+            p_all = [r for r in records if r["proxy"] == p]
+            p_shed = [r for r in shed if r["proxy"] == p]
+            per_proxy[str(p)] = {
+                "offered": len(p_all), "completed": len(p_ok),
+                "shed": len(p_shed),
+                "shed_rate": round(len(p_shed) / max(1, len(p_all)), 3),
+                "ttft_s": _percentiles(
+                    [r["ttft"] for r in p_ok if r["ttft"]],
+                    ps=(50, 95, 99)),
+            }
         rungs.append({
             "offered_rps": rate,
             "observed_rps": round(len(records) / args.rung_duration, 2),
@@ -565,8 +613,14 @@ def run_open_loop(args):
                 ps=(50, 95, 99)),
             "tokens": sum(r.get("tokens", 0) for r in ok),
             "per_tenant": per_tenant,
+            "per_proxy": per_proxy,
         })
         print(json.dumps({"rung": rungs[-1]}), flush=True)
+    prefix_stats = {}
+    if args.paged:
+        after = _engine_prefix_stats()
+        prefix_stats = {k: after[k] - prefix_stats_before.get(k, 0)
+                        for k in after}
     # Graceful saturation: the LAST rung must shed (we pushed past the
     # knee) while admitted p99 TTFT stays within the bound.
     admitted_p99 = [r["ttft_s"]["p99"] for r in rungs
@@ -576,6 +630,11 @@ def run_open_loop(args):
         "engine": "paged" if args.paged else "reserved",
         "new_tokens": args.new_tokens,
         "tenants": len(tenants),
+        "workload": args.workload,
+        "prefix_cache": bool(args.prefix_cache),
+        "prefix_tokens": args.prefix_tokens if shared else 0,
+        "proxies": len(ports),
+        "prefix_cache_stats": prefix_stats,
         "rungs": rungs,
         "saturation": {
             "sheds_at_peak": rungs[-1]["shed"] if rungs else 0,
@@ -690,6 +749,26 @@ def main():
                     help="rising offered-rate ladder (requests/s)")
     ap.add_argument("--rung-duration", type=float, default=10.0)
     ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--proxies", type=int, default=1,
+                    help="per-node ingress proxies to drive: N > 1 "
+                         "brings up an N-node cluster (one proxy per "
+                         "node) and round-robins the open-loop load "
+                         "across them, with a per-proxy shed/TTFT "
+                         "breakdown in the rung output")
+    ap.add_argument("--workload", default="random",
+                    choices=["random", "shared-prefix"],
+                    help="shared-prefix: every tenant's requests open "
+                         "with the tenant's fixed system prompt "
+                         "(--prefix-tokens) plus a unique tail — the "
+                         "prefix-cache target workload")
+    ap.add_argument("--prefix-tokens", type=int, default=48,
+                    help="shared system-prompt length per tenant "
+                         "(shared-prefix workload)")
+    ap.add_argument("--prefix-cache", type=int, default=None,
+                    choices=[0, 1],
+                    help="A/B toggle: run the paged engine with the "
+                         "prefix cache on (1) or off (0); unset keeps "
+                         "the pre-ISSUE-18 default (off)")
     ap.add_argument("--paged", action="store_true", default=True)
     ap.add_argument("--no-paged", dest="paged", action="store_false",
                     help="A/B: reserved max_len KV instead of paged")
@@ -708,17 +787,32 @@ def main():
     from ray_tpu.serve.llm import build_llm_app
 
     open_loop = args.mode in ("all", "open-loop")
-    ray_tpu.init(num_cpus=8, object_store_memory=512 * 1024 * 1024,
-                 _system_config={
-                     # Admit roughly what the engine can HOLD at
-                     # bounded TTFT (slots + ~1 wave of queue); streams
-                     # each occupy one pump thread for their life, so
-                     # the executor must cover max_inflight.
-                     "serve_ingress_max_inflight": 40,
-                     "serve_ingress_queue_watermark": 16,
-                     "serve_ingress_queue_timeout_s": 1.5,
-                     "serve_ingress_executor_threads": 64,
-                 } if open_loop else None)
+    ingress_cfg = {
+        # Admit roughly what the engine can HOLD at
+        # bounded TTFT (slots + ~1 wave of queue); streams
+        # each occupy one pump thread for their life, so
+        # the executor must cover max_inflight.
+        "serve_ingress_max_inflight": 40,
+        "serve_ingress_queue_watermark": 16,
+        "serve_ingress_queue_timeout_s": 1.5,
+        "serve_ingress_executor_threads": 64,
+    } if open_loop else None
+    cluster = None
+    if args.proxies > 1:
+        # One ingress proxy per node: an N-proxy front door needs an
+        # N-node cluster underneath it.
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 8})
+        for _ in range(args.proxies - 1):
+            cluster.add_node(num_cpus=4)
+        cluster.connect(object_store_memory=512 * 1024 * 1024,
+                        _system_config=ingress_cfg)
+        cluster.wait_for_nodes()
+    else:
+        ray_tpu.init(num_cpus=8, object_store_memory=512 * 1024 * 1024,
+                     _system_config=ingress_cfg)
     serve.start(http_port=args.http_port if open_loop else None)
     results = []
     opts = {"num_tpus": args.num_tpus_per_replica} \
@@ -733,7 +827,14 @@ def main():
                         max_queue=256)
             if args.paged:
                 ecfg.update(paged_kv=True, kv_block_size=16,
-                            prefill_chunk=16)
+                            prefill_chunk=16,
+                            prefix_cache_enabled=bool(args.prefix_cache))
+            if args.workload == "shared-prefix":
+                # Room for the system prompt + tail + decode; paged
+                # admission ignores prompt_buckets.
+                ecfg.update(max_len=max(
+                    ecfg["max_len"],
+                    args.prefix_tokens + 16 + args.new_tokens + 16))
             handle = serve.run(
                 build_llm_app(ecfg, mode="combined", name="llm",
                               autoscaling_config=None,
@@ -751,8 +852,15 @@ def main():
             print(json.dumps(results[-1]), flush=True)
 
         if args.mode in ("all", "engine"):
+            ecfg = _engine_config(args)
+            if args.prefix_cache is not None:
+                # Closed-loop prefix-cache A/B rides the paged engine
+                # (the cache only exists over the block pool).
+                ecfg.update(paged_kv=True, kv_block_size=16,
+                            prefill_chunk=16,
+                            prefix_cache_enabled=bool(args.prefix_cache))
             handle = serve.run(
-                build_llm_app(_engine_config(args), mode="combined",
+                build_llm_app(ecfg, mode="combined",
                               name="llm",
                               autoscaling_config=_autoscaling(args),
                               ray_actor_options=opts),
@@ -840,6 +948,8 @@ def main():
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
 
 
 if __name__ == "__main__":

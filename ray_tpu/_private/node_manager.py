@@ -2764,15 +2764,17 @@ class NodeManager:
         dead = []
         for conn, ent in ents:
             producer = ent["producer"]
-            for i, blob in enumerate(blobs):
-                if not producer.append(blob):
-                    try:
-                        _comp_ring_full_counter().inc(len(blobs) - i)
-                    except Exception:
-                        pass
-                    if producer.consumer_stale(self._COMP_RING_STALE_S):
-                        dead.append((conn, ent))
-                    break
+            # One batched append per relay: single tail publish, at
+            # most one doorbell for the whole batch (a parked driver
+            # used to eat one bell write per record).
+            appended = producer.append_batch(blobs)
+            if appended < len(blobs):
+                try:
+                    _comp_ring_full_counter().inc(len(blobs) - appended)
+                except Exception:
+                    pass
+                if producer.consumer_stale(self._COMP_RING_STALE_S):
+                    dead.append((conn, ent))
         for conn, ent in dead:
             try:
                 ent["producer"].close()

@@ -215,6 +215,47 @@ class Producer(_Mapped):
                 self._ring_bell()
         return True
 
+    def append_batch(self, blobs: List[bytes]) -> int:
+        """Append a FLUSH BATCH of records with at most ONE doorbell.
+
+        Same publication protocol as ``append`` — payload bytes first,
+        then one tail publish covering the whole batch — but the parked
+        check and bell write happen once per flush instead of once per
+        record (the remaining worker return-path tower in PROFILE_r12:
+        a parked driver cost one ``os.write`` per completion). Returns
+        the number of LEADING records appended; a short count means the
+        ring filled and the caller falls back to its socket path for
+        the rest (a partial batch is still fully published)."""
+        done = 0
+        with self._lock:
+            if self.dead or not self.active:
+                return 0
+            head = self._get(_OFF_HEAD)
+            tail = self._tail
+            for blob in blobs:
+                n = _LEN.size + len(blob)
+                if self.capacity - (tail - head) < n:
+                    break
+                self._write_data(tail, _LEN.pack(len(blob)) + blob)
+                tail += n
+                done += 1
+            if not done:
+                return 0
+            # Publish AFTER every payload of the batch: the consumer
+            # loads tail first, so it can never read an unwritten
+            # record — and sees the whole batch at one load.
+            self._tail = tail
+            self._put(_OFF_TAIL, self._tail)
+            parked = self._get(_OFF_PARKED)
+            backlog = self._tail - head
+        if parked:
+            now = time.monotonic()
+            if backlog <= 4096 \
+                    or now - self._last_bell >= self.BELL_MIN_INTERVAL_S:
+                self._last_bell = now
+                self._ring_bell()
+        return done
+
     def _ring_bell(self) -> None:
         s = self._bell
         if s is None:

@@ -815,11 +815,12 @@ class WorkerExecutor:
             prod = (self._comp_producers.get(conn)
                     if self._comp_producers else None)
             if prod is not None and prod.active and not prod.dead:
-                rest = []
-                for r in results:
-                    if not prod.append(pickle.dumps(r, protocol=5)):
-                        rest.append(r)
-                appended = len(results) - len(rest)
+                # One batched append per flush: a single tail publish
+                # and AT MOST ONE doorbell for the whole batch (vs one
+                # bell write per record while the driver was parked).
+                appended = prod.append_batch(
+                    [pickle.dumps(r, protocol=5) for r in results])
+                rest = results[appended:]
                 try:
                     if appended:
                         _worker_metrics()[1].inc(appended)

@@ -578,15 +578,21 @@ def prefill_chunk_paged(params: Params, pool: Dict[str, Any],
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=(
     "block_size",))
 def adopt_slot_paged(pool: Dict[str, Any], block_table: jax.Array,
-                     kv: Dict[str, Any], true_len: jax.Array, *,
+                     kv: Dict[str, Any], true_len: jax.Array,
+                     start: Optional[jax.Array] = None, *,
                      block_size: int) -> Dict[str, Any]:
     """Scatter a contiguous bucket-sized prefill KV block (the
     disaggregated handoff format, ``{"k","v": [L, 1, bucket, H, Dh]}``)
-    into a slot's pages. Pad rows past ``true_len`` go to scratch."""
+    into a slot's pages. Pad rows past ``true_len`` go to scratch, and
+    so do rows BEFORE ``start`` (the token offset of the slot's shared
+    prefix-cache prefix): a prefix-cache hit adopts only the suffix
+    rows, leaving the shared prefix blocks attention-read-only."""
     bucket = kv["k"].shape[2]
     logical = jnp.arange(bucket)
-    flat = _chunk_flat_positions(block_table, logical,
-                                 logical < true_len, block_size)
+    real = logical < true_len
+    if start is not None:
+        real = real & (logical >= start)
+    flat = _chunk_flat_positions(block_table, logical, real, block_size)
     k = pool["k"].at[:, flat].set(kv["k"][:, 0].astype(pool["k"].dtype))
     v = pool["v"].at[:, flat].set(kv["v"][:, 0].astype(pool["v"].dtype))
     return {"k": k, "v": v}

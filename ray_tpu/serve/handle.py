@@ -112,8 +112,16 @@ class DeploymentResponse:
 
 class DeploymentResponseGenerator:
     """Streaming response: iterates the items of a replica-side
-    generator, pulled one ``stream_next`` call at a time (lazy — the
-    replica generator only advances when the consumer asks)."""
+    generator, pulled lazily — the replica generator only advances when
+    the consumer asks. Each ``stream_next`` RPC requests a BATCH
+    (``max_items``): the replica returns its first item plus every item
+    already ready, and the local buffer drains before the next
+    round-trip, so per-item RPC count collapses on fast streams (the
+    SSE pump iterates this same object and inherits the batching)."""
+
+    # Per-RPC batch ceiling: bounds reply size while still collapsing
+    # the per-token round-trips of a fast producer.
+    _MAX_ITEMS = 16
 
     def __init__(self, replica, stream_id: str,
                  timeout_s: Optional[float] = None, on_done=None,
@@ -125,6 +133,8 @@ class DeploymentResponseGenerator:
         self._span = span
         self._status: Optional[str] = None
         self._exhausted = False
+        self._buf: List[Any] = []
+        self._done_after_buf = False
 
     def __iter__(self):
         return self
@@ -132,11 +142,17 @@ class DeploymentResponseGenerator:
     def __next__(self):
         import ray_tpu
 
+        if self._buf:
+            return self._buf.pop(0)
         if self._exhausted:
+            raise StopIteration
+        if self._done_after_buf:
+            self._finish("ok")
             raise StopIteration
         try:
             out = ray_tpu.get(
-                self._replica.stream_next.remote(self._sid),
+                self._replica.stream_next.remote(self._sid,
+                                                 self._MAX_ITEMS),
                 timeout=self._timeout)
         except BaseException:
             # Tell the replica before marking ourselves exhausted: a
@@ -149,6 +165,15 @@ class DeploymentResponseGenerator:
             self._status = "error"
             self.cancel()
             raise
+        if "items" in out:
+            self._buf = list(out["items"])
+            if out.get("done"):
+                # Deliver the trailing items first; stop after.
+                self._done_after_buf = True
+            if self._buf:
+                return self._buf.pop(0)
+            self._finish("ok")
+            raise StopIteration
         if out.get("done"):
             self._finish("ok")
             raise StopIteration

@@ -71,6 +71,9 @@ class EngineConfig:
     prefill_chunk: int = 32           # chunked-prefill chunk length
     max_kv_bytes: int = 0             # 0 = unlimited; else engine init
     #                                   refuses a KV allocation above it
+    prefix_cache_enabled: bool = False  # share full-prompt-prefix KV
+    #                                   blocks across requests (paged
+    #                                   only; see serve/llm/paged.py)
     # --- prefill micro-batching (PrefillReplica) ----------------------
     prefill_batch_size: int = 1       # 1 = one prompt per program call
     prefill_batch_window_ms: float = 2.0
@@ -162,6 +165,21 @@ def engine_metrics() -> Dict[str, Any]:
                     "Sequences preempted (recompute-resumed) because "
                     "the KV block pool could not grow them.",
                     tag_keys=tags),
+                "prefix_hit_tokens": Counter(
+                    "serve_llm_prefix_cache_hit_tokens_total",
+                    "Prompt tokens served from shared prefix-cache "
+                    "blocks instead of being re-prefilled.",
+                    tag_keys=tags),
+                "prefix_lookup_tokens": Counter(
+                    "serve_llm_prefix_cache_lookup_tokens_total",
+                    "Prompt tokens presented to the prefix-cache chain "
+                    "lookup (the hit-rate denominator).",
+                    tag_keys=tags),
+                "kv_shared_blocks": Gauge(
+                    "serve_llm_kv_shared_blocks",
+                    "KV blocks currently referenced by more than one "
+                    "sequence (live prefix sharing).",
+                    tag_keys=tags),
             }
         return _metrics
 
@@ -241,7 +259,8 @@ class InflightBatchEngine:
             self._slot_blocks_max = -(-engine_cfg.max_len // bs)
             nb = engine_cfg.kv_pool_blocks()
             self._check_kv_budget(nb * bs * per_tok, "paged KV pool")
-            self._pool = BlockPool(nb, bs)
+            self._pool = BlockPool(
+                nb, bs, prefix_cache=engine_cfg.prefix_cache_enabled)
             self._cache = init_paged_pool(cfg, nb, bs, B,
                                           self._slot_blocks_max)
             # Host mirrors of the device block tables / lengths; pushed
@@ -270,6 +289,11 @@ class InflightBatchEngine:
         self._requests: Dict[str, _Request] = {}
         self._stopped = False
         self._steps = 0
+        # Prefix-cache accounting (scheduler thread writes; stats()
+        # readers tolerate a torn int read).
+        self._prefix_hit_tokens = 0
+        self._prefix_lookup_tokens = 0
+        self._prefill_tokens_computed = 0
 
         self._tags = {"deployment": deployment, "replica": replica_id}
         self._m = engine_metrics()
@@ -502,6 +526,13 @@ class InflightBatchEngine:
             "paged_kv": self._pool is not None,
         }
         out.update(pool_stats)
+        if self._pool is not None:
+            out["prefix_cache_enabled"] = self._pool.prefix_cache
+            out["prefix_cache_hit_tokens"] = self._prefix_hit_tokens
+            out["prefix_cache_lookup_tokens"] = \
+                self._prefix_lookup_tokens
+            out["prefill_tokens_computed"] = \
+                self._prefill_tokens_computed
         return out
 
     def stop(self) -> None:
@@ -523,6 +554,7 @@ class InflightBatchEngine:
             self._m["batch_occupancy"].set(0, self._tags)
             if self._pool is not None:
                 self._m["kv_occupancy"].set(0, self._tags)
+                self._m["kv_shared_blocks"].set(0, self._tags)
 
     # ----------------------------------------------------------- scheduler
 
@@ -669,11 +701,13 @@ class InflightBatchEngine:
     # ------------------------------------------------- paged-KV scheduling
 
     def _free_slot_blocks(self, slot: int) -> None:
-        """Return a slot's blocks to the pool and point its table at the
-        scratch block (a stale table must never alias a reassigned
-        block). Called with ``_cv`` held or from the scheduler thread."""
+        """Release a slot's blocks back to the pool (a DECREF — shared
+        prefix blocks another sequence still reads, or the cache wants
+        warm, stay resident) and point its table at the scratch block
+        (a stale table must never alias a reassigned block). Called
+        with ``_cv`` held or from the scheduler thread."""
         if self._blocks[slot]:
-            self._pool.free(self._blocks[slot])
+            self._pool.release(self._blocks[slot])
             self._blocks[slot] = []
         self._bt[slot] = 0
         self._lengths[slot] = 0
@@ -685,6 +719,8 @@ class InflightBatchEngine:
         if self._pool is not None:
             self._m["kv_occupancy"].set(self._pool.occupancy(),
                                         self._tags)
+            self._m["kv_shared_blocks"].set(
+                self._pool.shared_blocks(), self._tags)
 
     def _sync_device_tables(self) -> None:
         """Push the host block-table / length mirrors to the device
@@ -702,7 +738,15 @@ class InflightBatchEngine:
         queue; prefilled handoffs adopt their KV block into pages
         directly. Block allocation is all-or-nothing per sequence and
         FIFO — a request the pool cannot serve YET parks at the queue
-        head rather than being overtaken (no starvation)."""
+        head rather than being overtaken (no starvation).
+
+        With the prefix cache on, the sequence's full-block prefix is
+        matched against the pool's hash chain first: matched blocks
+        join the slot's table BY REFERENCE (refcount bump, attention-
+        read-only) and only the suffix is prefilled — or, for a
+        disaggregated handoff, only the suffix rows of the prefill
+        block are scattered (the handoff adopts refcounts rather than
+        copying shared rows)."""
         import jax.numpy as jnp
 
         from ray_tpu.models.generate import adopt_slot_paged
@@ -727,12 +771,14 @@ class InflightBatchEngine:
                                            self._tags)
 
             if req.kind == "prefilled" and req.resume_tokens is None:
+                seq = req.prompt or []
                 seq_len = req.true_len
             else:
                 seq = req.resume_tokens if req.resume_tokens is not None \
                     else req.prompt
                 seq_len = len(seq)
-            got = self._pool.alloc(self._pool.blocks_for(seq_len))
+            got = self._pool.get_or_alloc(
+                seq, self._pool.blocks_for(seq_len))
             if got is None:
                 # Pool busy: give the slot back and repark at the HEAD.
                 with self._cv:
@@ -742,15 +788,24 @@ class InflightBatchEngine:
                         self._m["queue_depth"].set(len(self._pending),
                                                    self._tags)
                 break
-            self._blocks[slot] = got
+            blocks, matched = got
+            if self._pool.prefix_cache:
+                self._prefix_lookup_tokens += seq_len
+                self._m["prefix_lookup_tokens"].inc(seq_len, self._tags)
+                if matched:
+                    self._prefix_hit_tokens += matched
+                    self._m["prefix_hit_tokens"].inc(matched, self._tags)
+            self._blocks[slot] = blocks
             self._bt[slot] = 0
-            self._bt[slot][:len(got)] = got
+            self._bt[slot][:len(blocks)] = blocks
             self._bt_dirty = True
 
             if req.kind == "prefilled" and req.resume_tokens is None:
                 # Disaggregated handoff: splice the contiguous prefill
-                # block into the slot's pages; the first token was
-                # sampled (and delivered) by the prefill pool.
+                # block into the slot's pages — only the rows past the
+                # shared prefix; matched blocks already hold identical
+                # KV and stay read-only. The first token was sampled
+                # (and delivered) by the prefill pool.
                 try:
                     kv = {"k": jnp.asarray(req.kv["k"]),
                           "v": jnp.asarray(req.kv["v"])}
@@ -761,6 +816,7 @@ class InflightBatchEngine:
                     pool_kv = adopt_slot_paged(
                         pool_kv, jnp.asarray(self._bt[slot]), kv,
                         jnp.int32(req.true_len),
+                        start=jnp.int32(matched),
                         block_size=self._pool.block_size)
                     self._cache["k"] = pool_kv["k"]
                     self._cache["v"] = pool_kv["v"]
@@ -771,6 +827,8 @@ class InflightBatchEngine:
                         self._free_slot_blocks(slot)
                         self._cv.notify_all()
                     continue
+                if seq:
+                    self._pool.register(seq, blocks)
                 self._activate_slot_paged(slot, req, seq_len=req.true_len,
                                           token=req.first_token,
                                           emit=False)
@@ -778,7 +836,7 @@ class InflightBatchEngine:
                 with self._cv:
                     self._prefill_q.append(
                         {"slot": slot, "req": req, "tokens": seq,
-                         "done": 0})
+                         "done": matched})
             progress = True
         return progress
 
@@ -814,14 +872,19 @@ class InflightBatchEngine:
         self._cache["k"] = pool_kv["k"]
         self._cache["v"] = pool_kv["v"]
         entry["done"] = start + len(chunk)
+        self._prefill_tokens_computed += len(chunk)
         if entry["done"] < len(toks):
             return True
         with self._cv:
             if self._prefill_q and self._prefill_q[0] is entry:
                 self._prefill_q.pop(0)
-        # Prefill complete: the sampled token is the next token of the
-        # sequence (for a resume, the continuation token — same counter
-        # the uninterrupted decode would have used).
+        # Prefill complete: register the sequence's full blocks in the
+        # prefix chain (matched-prefix keys are already there; the
+        # freshly computed suffix blocks become findable) …
+        self._pool.register(toks, self._blocks[slot])
+        # … and the sampled token is the next token of the sequence
+        # (for a resume, the continuation token — same counter the
+        # uninterrupted decode would have used).
         self._activate_slot_paged(
             slot, req, seq_len=len(toks), token=int(first[0]),
             emit=not (req.kind == "prefilled" and req.produced == 0))

@@ -42,25 +42,56 @@ class _EngineStream:
     def __init__(self, engine: InflightBatchEngine, req_id: str):
         self._engine = engine
         self._req_id = req_id
-        self._gen = engine.stream(req_id)
+        self._done = False
 
     def __iter__(self) -> Iterator[List[int]]:
         return self
 
     def __next__(self) -> List[int]:
-        return next(self._gen)
+        if self._done:
+            raise StopIteration
+        while True:
+            out = self._engine.drain(self._req_id, max_wait_s=1.0)
+            if out["done"]:
+                self._done = True
+            if out["tokens"]:
+                return out["tokens"]
+            if self._done:
+                raise StopIteration
+
+    def next_ready(self) -> Optional[List[int]]:
+        """Non-blocking probe: the chunk that has ALREADY accumulated,
+        or None when nothing is ready yet. ``stream_next``'s batched
+        pull drains these after its first (blocking) item, so a fast
+        producer costs one RPC per batch instead of one per chunk.
+        Raises StopIteration at exhaustion, like ``__next__``."""
+        if self._done:
+            raise StopIteration
+        out = self._engine.drain(self._req_id, max_wait_s=0.0)
+        if out["done"]:
+            self._done = True
+        if out["tokens"]:
+            return out["tokens"]
+        if self._done:
+            raise StopIteration
+        return None
 
     def close(self) -> None:
-        # Cancel FIRST: close() usually arrives from another thread
-        # (stream_cancel RPC) while __next__ is blocked inside drain —
-        # generator.close() then raises 'generator already executing'
-        # and must not gate the engine-side cleanup (cancel is
-        # thread-safe and idempotent; the running drain sees the
-        # request disappear and the generator winds down).
+        # Cancel is thread-safe and idempotent: close() usually arrives
+        # from another thread (stream_cancel RPC) while __next__ is
+        # blocked inside drain — the running drain sees the request
+        # disappear and winds down.
+        self._done = True
         self._engine.cancel(self._req_id)
+
+    def __del__(self):
+        # A stream dropped without close() (consumer process died
+        # between RPCs) must still cancel the engine request so the
+        # slot and its KV blocks free.
         try:
-            self._gen.close()
-        except ValueError:   # mid-__next__ in another thread
+            if not self._done:
+                self._engine.cancel(self._req_id)
+        except Exception:
             pass
 
 

@@ -146,25 +146,49 @@ class Replica:
             self._streams[sid] = {"iter": it, "lock": threading.Lock()}
         return sid
 
-    def stream_next(self, stream_id: str) -> Dict[str, Any]:
-        """Pull the next item of a stream. ``{"item": x, "done": False}``
-        or ``{"done": True}`` at exhaustion (the stream is then
-        forgotten). Errors from the generator tear the stream down and
-        propagate to the caller."""
+    def stream_next(self, stream_id: str,
+                    max_items: int = 1) -> Dict[str, Any]:
+        """Pull the next item(s) of a stream. ``{"item": x, "done":
+        False}`` or ``{"done": True}`` at exhaustion (the stream is
+        then forgotten). Errors from the generator tear the stream down
+        and propagate to the caller.
+
+        With ``max_items > 1`` the reply is ``{"items": [...], "done":
+        bool}``: after the first (blocking) item, every item the
+        iterator reports ALREADY READY — via an optional non-blocking
+        ``next_ready()`` probe (returns None when nothing is pending;
+        the engine streams implement it) — rides the same RPC, so a
+        producer that outruns the consumer costs one round-trip per
+        batch instead of one per item. ``done: True`` may arrive WITH
+        trailing items; the caller delivers them before stopping."""
         with self._lock:
             st = self._streams.get(stream_id)
         if st is None:
             return {"done": True}
+        items: list = []
+        done = False
         try:
             with st["lock"]:
-                item = next(st["iter"])
-            return {"item": item, "done": False}
+                items.append(next(st["iter"]))
+                probe = getattr(st["iter"], "next_ready", None) \
+                    if max_items > 1 else None
+                while probe is not None and len(items) < max_items:
+                    nxt = probe()
+                    if nxt is None:
+                        break
+                    items.append(nxt)
         except StopIteration:
-            self._drop_stream(stream_id)
-            return {"done": True}
+            done = True
         except BaseException:
             self._drop_stream(stream_id)
             raise
+        if done:
+            self._drop_stream(stream_id)
+        if max_items <= 1:
+            if done:
+                return {"done": True}
+            return {"item": items[0], "done": False}
+        return {"items": items, "done": done}
 
     def stream_cancel(self, stream_id: str) -> bool:
         """Abandon a stream (consumer went away)."""

@@ -72,6 +72,11 @@ LOCKDEP_MODULES = {
     # the lease/NM lock graph. Witness the edges where its tests drive
     # them.
     "test_completion_fastpath",
+    # Prefix caching shares refcounted KV blocks across slots under the
+    # engine's admission lock while the scheduler thread allocates,
+    # registers and releases them — witness the engine/pool lock edges
+    # the sharing adds (admission, preemption, cancel, disagg adopt).
+    "test_prefix_cache",
 }
 
 

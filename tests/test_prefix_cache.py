@@ -1,0 +1,304 @@
+"""Prefix caching over the paged KV block pool (ISSUE 18): shared
+refcounted blocks, cache-aware chunked prefill, eviction, and the
+bit-identical-output contract.
+
+The cache is a pure prefill-compute optimization: with it on or off,
+every request must produce token-for-token identical output (greedy AND
+sampled), and after any churn — retirement, cancel, preemption, disagg
+handoff — the pool must drain to zero used and zero shared blocks.
+"""
+
+import itertools
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.serve.llm import paged
+from ray_tpu.serve.llm.engine import EngineConfig, InflightBatchEngine
+from ray_tpu.serve.llm.paged import BlockPool
+from ray_tpu.serve.llm.replicas import _build_model
+
+BASE = dict(preset="tiny", model_overrides={"dtype": "float32"},
+            max_slots=4, max_len=64, prompt_buckets=(16,),
+            max_new_tokens=16)
+BS = 4
+N = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg, params = _build_model(EngineConfig.from_dict(BASE))
+    return cfg, params
+
+
+def _engine(model, prefix_cache, **kw):
+    cfg, params = model
+    ec = EngineConfig.from_dict(dict(
+        BASE, paged_kv=True, kv_block_size=BS, prefill_chunk=BS,
+        prefix_cache_enabled=prefix_cache, **kw))
+    return InflightBatchEngine(params, cfg, ec)
+
+
+def _run(eng, jobs):
+    """Submit (prompt, seed) jobs and collect each full token stream."""
+    rids = [eng.submit(p, N, seed=s) for p, s in jobs]
+    return [list(itertools.chain.from_iterable(
+        eng.stream(r, max_wait_s=10))) for r in rids]
+
+
+def _drained(eng, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = eng.stats()
+        if s["kv_blocks_used"] == 0 and s["busy_slots"] == 0:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# --------------------------------------------------------------- pool
+
+
+def test_pool_chain_sharing_and_refcounts():
+    pool = BlockPool(17, BS, prefix_cache=True)   # 16 usable
+    toks = list(range(100, 116))                  # 4 full blocks
+
+    got = pool.get_or_alloc(toks, pool.blocks_for(len(toks)))
+    assert got is not None
+    blocks, matched = got
+    assert matched == 0 and len(blocks) == 4      # cold: all fresh
+    pool.register(toks, blocks)
+    assert pool.cached_blocks() == 4
+
+    # A twin prompt shares every full block STRICTLY before its last
+    # token: 16 tokens -> (16-1)//4 = 3 shared, 4th recomputed fresh.
+    got2 = pool.get_or_alloc(toks, 4)
+    blocks2, matched2 = got2
+    assert matched2 == 3 * BS and blocks2[:3] == blocks[:3]
+    assert blocks2[3] != blocks[3]
+    assert pool.shared_blocks() == 3
+    assert pool.stats()["kv_shared_blocks"] == 3
+
+    # Release one side: shared blocks stay referenced by the other.
+    pool.release(blocks2)
+    assert pool.shared_blocks() == 0 and pool.used() == 4
+    pool.release(blocks)
+    # Cached blocks park on the idle LRU, NOT the free list: still
+    # matchable, not "used", reclaimable on demand.
+    assert pool.used() == 0 and pool.cached_blocks() == 4
+    assert pool.match_prefix(toks + [1])[1] == 4 * BS
+
+
+def test_eviction_lru_never_reclaims_referenced_blocks():
+    pool = BlockPool(9, BS, prefix_cache=True)    # 8 usable
+    hot = list(range(10, 18))                     # 2 blocks, stays held
+    cold = list(range(50, 58))                    # 2 blocks, released
+
+    hot_blocks, _ = pool.get_or_alloc(hot, 2)
+    pool.register(hot, hot_blocks)
+    cold_blocks, _ = pool.get_or_alloc(cold, 2)
+    pool.register(cold, cold_blocks)
+    pool.release(cold_blocks)                     # idle, evictable
+    assert pool.available() == 4
+
+    # Demand 6 blocks: 4 free + both idle cold blocks evicted; the
+    # referenced hot chain must survive untouched.
+    six = pool.alloc(6)
+    assert six is not None and len(six) == 6
+    assert pool.stats()["kv_prefix_evictions_total"] == 2
+    assert pool.match_prefix(cold + [1])[1] == 0      # evicted
+    assert pool.match_prefix(hot + [1])[1] == 2 * BS  # survived
+    assert set(six).isdisjoint(hot_blocks)
+
+    # With everything referenced, further demand fails all-or-nothing
+    # rather than stealing referenced blocks.
+    assert pool.alloc(1) is None
+    pool.release(hot_blocks)
+    assert pool.alloc(1) is not None                  # idle hot evicts
+
+
+def test_pool_hash_collision_degrades_to_miss(monkeypatch):
+    """All chain keys colliding must yield ZERO false matches — lookups
+    verify token ids and the parent link, not just the hash."""
+    monkeypatch.setattr(paged, "_chain_key",
+                        lambda parent, tokens: b"same-key-always")
+    pool = BlockPool(17, BS, prefix_cache=True)
+    a = list(range(100, 108))
+    blocks, _ = pool.get_or_alloc(a, 2)
+    pool.register(a, blocks)
+    # Different tokens, same (colliding) key: MISS, never a wrong block.
+    assert pool.match_prefix(list(range(200, 208)) + [1]) == ([], 0)
+    got = pool.get_or_alloc(list(range(200, 212)), 3)
+    assert got is not None and got[1] == 0
+    # The genuine twin still matches (token verification passes) —
+    # though under total collision only one chain can be cached.
+    assert pool.match_prefix(a + [1])[1] == BS
+
+
+# ------------------------------------------------- bit-identical output
+
+
+def test_bit_identical_greedy_cache_on_off(model):
+    common = [7, 3, 9, 1, 4, 4, 2, 8, 6, 5, 1, 2]   # 3 full blocks
+    warm = [(common + [11], 0)]
+    jobs = [(common + tail, 0) for tail in
+            ([12, 13], [14, 15, 16, 17], [11])]
+    on, off = _engine(model, True), _engine(model, False)
+    try:
+        # Warm sequentially (so the prefix is registered), then a
+        # concurrent wave that shares it.
+        got_off = _run(off, warm) + _run(off, jobs)
+        got_on = _run(on, warm) + _run(on, jobs)
+        assert got_on == got_off
+        s = on.stats()
+        assert s["prefix_cache_enabled"] is True
+        assert s["prefix_cache_hit_tokens"] > 0
+        # The cache did real work: fewer prompt tokens prefilled than
+        # the off engine computed.
+        assert s["prefill_tokens_computed"] < \
+            off.stats()["prefill_tokens_computed"]
+        assert _drained(on) and _drained(off)
+        assert on._pool.shared_blocks() == 0
+    finally:
+        on.stop()
+        off.stop()
+
+
+def test_bit_identical_sampled_cache_on_off(model):
+    common = [5, 1, 8, 8, 2, 9, 3, 7]
+    jobs = [(common + [20 + i], 100 + i) for i in range(4)] + \
+        [(common + [20], 100)]                      # exact repeat too
+    on = _engine(model, True, temperature=0.9, top_k=16)
+    off = _engine(model, False, temperature=0.9, top_k=16)
+    try:
+        assert _run(on, jobs) == _run(off, jobs)
+        assert on.stats()["prefix_cache_hit_tokens"] > 0
+    finally:
+        on.stop()
+        off.stop()
+
+
+def test_divergence_at_block_boundary_plus_minus_one(model):
+    """Prompt pairs diverging exactly at a block boundary and one token
+    to either side: outputs stay bit-identical, and the matched prefix
+    never covers the divergent token (the divergence block is always
+    freshly computed)."""
+    base = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7]
+    for div in (2 * BS - 1, 2 * BS, 2 * BS + 1):
+        pair = [base[:div] + [30] + base[div:],
+                base[:div] + [40] + base[div:]]
+        jobs = [(p, 0) for p in pair]
+        on, off = _engine(model, True), _engine(model, False)
+        try:
+            assert _run(on, jobs) == _run(off, jobs), div
+            # Sharing is capped at the full blocks strictly before the
+            # divergence point.
+            assert on.stats()["prefix_cache_hit_tokens"] <= \
+                (div // BS) * BS * 2
+            assert _drained(on)
+        finally:
+            on.stop()
+            off.stop()
+
+
+def test_engine_collision_safety_bit_identical(model, monkeypatch):
+    """Even with EVERY chain key colliding, engine output is unchanged
+    — the cache degrades to misses, never to wrong KV."""
+    monkeypatch.setattr(paged, "_chain_key",
+                        lambda parent, tokens: b"collide")
+    prompts = [[1, 2, 3, 4, 5, 6, 7, 8, 9],
+               [9, 8, 7, 6, 5, 4, 3, 2, 1],
+               [1, 2, 3, 4, 5, 6, 7, 8, 9]]
+    jobs = [(p, 0) for p in prompts]
+    on, off = _engine(model, True), _engine(model, False)
+    try:
+        assert _run(on, jobs) == _run(off, jobs)
+    finally:
+        on.stop()
+        off.stop()
+
+
+# ------------------------------------------------------- leak checks
+
+
+def test_preemption_churn_drains_to_zero(model):
+    """Contention-driven recompute-preemption with the cache on: every
+    request still gets its exact solo tokens, and the pool drains to
+    zero used / zero shared blocks (no leak, no double free)."""
+    cfg, params = model
+    solo = _engine(model, True)
+    tight = _engine(model, True, kv_num_blocks=9)   # 8 usable blocks
+    try:
+        common = [2, 7, 1, 8, 2, 8]
+        jobs = [(common + [50 + i], i) for i in range(3)]
+        expect = _run(solo, jobs)
+        assert _run(tight, jobs) == expect
+        assert _drained(tight)
+        pool = tight._pool
+        assert pool.shared_blocks() == 0
+        assert not pool._refs, pool._refs
+        # Every block is either free or parked idle in the cache.
+        assert pool.available() + len(pool._idle) == pool.capacity
+    finally:
+        solo.stop()
+        tight.stop()
+
+
+def test_cancel_releases_shared_blocks(model):
+    eng = _engine(model, True)
+    try:
+        warm = [6, 6, 6, 6, 1, 1, 1, 1, 3]
+        _run(eng, [(warm, 0)])                      # populate the cache
+        rid = eng.submit(warm[:-1] + [4], 40)       # shares 2 blocks
+        deadline = time.time() + 10
+        while time.time() < deadline and eng.stats()["busy_slots"] == 0:
+            time.sleep(0.02)
+        eng.cancel(rid)
+        assert _drained(eng)
+        assert eng._pool.shared_blocks() == 0
+        assert not eng._pool._refs
+        # The cached prefix survived the cancel and still matches.
+        assert eng._pool.match_prefix(warm)[1] == 2 * BS
+    finally:
+        eng.stop()
+
+
+def test_disagg_handoff_adopts_and_registers(model):
+    """submit_prefilled on a prefix-caching pool: the adopted sequence's
+    full blocks register in the chain (a later twin prompt hits them),
+    suffix decode is bit-identical to the cache-off engine, and the
+    handoff's blocks release cleanly at retirement."""
+    from ray_tpu.models.generate import prefill_slot
+
+    cfg, params = model
+    prompt = [5, 9, 2, 11, 3, 7, 1, 4]              # 2 full blocks
+    padded = jnp.zeros((1, 16), jnp.int32).at[:, :len(prompt)].set(
+        jnp.asarray(prompt, jnp.int32))
+    first, kv = prefill_slot(params, padded, jnp.int32(len(prompt)),
+                             jnp.int32(0), cfg=cfg)
+    jax.block_until_ready(kv)
+    kv = {"k": kv["k"], "v": kv["v"]}
+
+    on, off = _engine(model, True), _engine(model, False)
+    try:
+        outs = {}
+        for eng in (on, off):
+            rid = eng.submit_prefilled(int(first[0]), kv, len(prompt),
+                                       N, seed=0, prompt=prompt)
+            outs[eng] = list(itertools.chain.from_iterable(
+                eng.stream(rid, max_wait_s=10)))
+        assert outs[on] == outs[off]
+        assert _drained(on)
+        assert on._pool.match_prefix(prompt + [1])[1] == 2 * BS
+        # A twin prompt now prefills only its suffix.
+        before = on.stats()["prefix_cache_hit_tokens"]
+        assert _run(on, [(prompt + [9], 0)]) == \
+            _run(off, [(prompt + [9], 0)])
+        assert on.stats()["prefix_cache_hit_tokens"] == before + 2 * BS
+        assert _drained(on)
+        assert on._pool.shared_blocks() == 0 and not on._pool._refs
+    finally:
+        on.stop()
+        off.stop()

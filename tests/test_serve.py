@@ -199,6 +199,122 @@ def test_streaming_generator_through_handle(serve_cluster):
     serve.delete("streamer")
 
 
+class _ReadyIter:
+    """Iterator with the engine streams' non-blocking ``next_ready``
+    probe: every item is already ready, so a batched ``stream_next``
+    should pack up to ``max_items`` per RPC."""
+
+    def __init__(self, items):
+        self._items = list(items)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._items:
+            raise StopIteration
+        return self._items.pop(0)
+
+    def next_ready(self):
+        if not self._items:
+            raise StopIteration
+        return self._items.pop(0)
+
+
+def test_stream_next_batches_ready_items():
+    """Replica-level batching parity at every chunk boundary: for
+    stream lengths straddling the batch size (k-1, k, k+1, 2k, 2k+1),
+    batched pulls return the identical item sequence, never more than
+    ``max_items`` per reply, and the done flag rides with (or directly
+    after) the trailing items — no lost tail, no phantom extra pull."""
+    import cloudpickle
+
+    from ray_tpu.serve.replica import Replica
+
+    class Src:
+        def stream(self, n):
+            return _ReadyIter(range(n))
+
+    rep = Replica(cloudpickle.dumps(Src), (), {}, "src", "r0")
+    k = 8
+    for n in (0, 1, k - 1, k, k + 1, 2 * k, 2 * k + 1):
+        sid = rep.handle_request_stream("stream", (n,), {})
+        got = []
+        replies = 0
+        while True:
+            out = rep.stream_next(sid, max_items=k)
+            replies += 1
+            items = out.get("items", [])
+            assert len(items) <= k
+            got.extend(items)
+            if out["done"]:
+                break
+            assert items, "no-progress reply on an all-ready stream"
+        assert got == list(range(n)), f"n={n}"
+        # All-ready items pack maximally: ceil(n/k) data replies plus
+        # at most one trailing done-only reply.
+        assert replies <= -(-n // k) + 1, f"n={n}: {replies} replies"
+        assert rep.stats()["ongoing"] == 0
+
+    # A probe that reports "nothing ready" (None) ends the batch early
+    # without ending the stream.
+    class Trickle:
+        def __init__(self, items):
+            self._items = list(items)
+
+        def __next__(self):
+            if not self._items:
+                raise StopIteration
+            return self._items.pop(0)
+
+        def next_ready(self):
+            return None
+
+    rep2 = Replica(cloudpickle.dumps(lambda: Trickle([1, 2])), (), {},
+                   "trickle", "r0")
+    sid = rep2.handle_request_stream("__call__", (), {})
+    assert rep2.stream_next(sid, max_items=k) == {
+        "items": [1], "done": False}
+    assert rep2.stream_next(sid, max_items=k) == {
+        "items": [2], "done": False}
+    assert rep2.stream_next(sid, max_items=k) == {"items": [], "done": True}
+
+
+def test_remote_gen_batched_parity(serve_cluster):
+    """End-to-end parity: the handle's batched ``remote_gen`` yields
+    token-for-token the same sequence as a forced one-item-per-RPC
+    pull, across lengths straddling the client batch size — the
+    batching is a transport optimization, never a semantic change."""
+    from ray_tpu.serve.handle import DeploymentResponseGenerator
+
+    @serve.deployment(name="batcher")
+    class Batcher:
+        def ready(self, n):
+            return _ReadyIter([{"i": i} for i in range(n)])
+
+        def gen(self, n):
+            for i in range(n):
+                yield i * 3
+
+    handle = serve.run(Batcher.bind(), http_port=None)
+    k = DeploymentResponseGenerator._MAX_ITEMS
+    try:
+        for n in (0, 1, k - 1, k, k + 1, 2 * k + 1):
+            want = [{"i": i} for i in range(n)]
+            assert list(handle.ready.remote_gen(n)) == want, f"n={n}"
+            # Forced legacy path: one item per RPC, same sequence.
+            DeploymentResponseGenerator._MAX_ITEMS = 1
+            try:
+                assert list(handle.ready.remote_gen(n)) == want, f"n={n}"
+            finally:
+                DeploymentResponseGenerator._MAX_ITEMS = k
+        # Plain generators (no next_ready probe) keep exact parity too.
+        assert list(handle.gen.remote_gen(5)) == [0, 3, 6, 9, 12]
+    finally:
+        DeploymentResponseGenerator._MAX_ITEMS = k
+        serve.delete("batcher")
+
+
 def test_replica_persistent_event_loop(serve_cluster):
     """Async deployments share ONE event loop across requests (the old
     per-request ``asyncio.run`` gave every call a fresh loop, breaking
