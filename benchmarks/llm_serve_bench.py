@@ -507,9 +507,16 @@ def _sse_request(port, payload, headers, rec):
                     data = line[len(b"data: "):]
                     now = time.perf_counter()
                     if data == b"[DONE]":
+                        # Explicit completion marker: an admitted (200)
+                        # stream without it was LOST mid-flight — the
+                        # chaos bench's zero-loss criterion keys on it.
+                        rec["done"] = True
                         rec["t_done"] = now - t0
                         return
-                    n_toks = len(json.loads(data)["choices"][0]["tokens"])
+                    toks = json.loads(data)["choices"][0]["tokens"]
+                    n_toks = len(toks)
+                    if "token_ids" in rec:
+                        rec["token_ids"].extend(int(t) for t in toks)
                     if rec.get("ttft") is None:
                         rec["ttft"] = now - t0
                     elif n_toks:
@@ -721,11 +728,320 @@ def run_long_context(args):
     }
 
 
+def _fault_stats():
+    import ray_tpu
+    from ray_tpu.serve.api import _controller
+
+    return ray_tpu.get(_controller().fault_stats.remote(), timeout=30)
+
+
+def _router_migrations(name):
+    """Sum ``request_migrations_total`` over the router deployment's
+    replicas — engine/decode deaths resubmit inside the ROUTER process,
+    so that is where the tally lives."""
+    import ray_tpu
+
+    total = 0
+    try:
+        reps = _pool_replicas(name)
+    except Exception:
+        return 0
+    for rep in reps:
+        try:
+            st = ray_tpu.get(rep.stats.remote(), timeout=10)
+            total += int(st.get("request_migrations_total") or 0)
+        except Exception:
+            continue
+    return total
+
+
+def _kill_one_replica(pool, killed, kills, t0, require_busy=True):
+    """SIGKILL one live replica process of ``pool``. With
+    ``require_busy`` only a replica with in-flight work is eligible —
+    killing an idle spare proves nothing about migration."""
+    import signal
+
+    import ray_tpu
+
+    try:
+        reps = _pool_replicas(pool)
+    except Exception:
+        return False
+    stats = []
+    for rep in reps:
+        try:
+            stats.append(ray_tpu.get(rep.stats.remote(), timeout=10))
+        except Exception:
+            continue
+    stats = [s for s in stats
+             if s.get("pid") and s["pid"] not in killed]
+    if require_busy:
+        stats = [s for s in stats if int(s.get("ongoing") or 0) > 0]
+    if not stats:
+        return False
+    stats.sort(key=lambda s: -int(s.get("ongoing") or 0))
+    pid = int(stats[0]["pid"])
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except OSError:
+        return False
+    killed.add(pid)
+    kills.append({"pool": pool, "pid": pid,
+                  "ongoing_at_kill": int(stats[0].get("ongoing") or 0),
+                  "t_s": round(time.perf_counter() - t0, 2)})
+    return True
+
+
+def run_chaos(args):
+    """Crash-transparency proof: SIGKILL engine/decode replicas under
+    open SSE load, in BOTH serving modes. Criteria (asserted):
+
+    - zero lost admitted requests — every stream the proxy answered
+      with 200 reaches ``[DONE]``; sheds (429/503) are allowed, silent
+      truncation is not;
+    - bit-identical resume — deterministic greedy probe prompts,
+      referenced before any chaos, stream back the exact same token
+      ids THROUGH the migrations (no duplicate, no gap);
+    - migrations observed (router ``request_migrations_total`` > 0) and
+      every kill detected + replaced by the controller
+      (``serve_replica_restarts_total`` delta, ``time_to_replace_s``
+      recorded per replacement — the satellite-f histogram);
+    - combined mode additionally redeploys the app mid-load: the
+      controller drains the old generation (``drain_duration_s``
+      entries appear) and no in-flight request fails.
+    """
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_llm_app
+
+    port = _proxy_ports()[0]
+    n_kills = max(2, args.chaos_kills)
+    modes_out = []
+    for mode in ("combined", "disaggregated"):
+        # Wider prompt buckets than the open-loop default: a migrated
+        # stream re-prefills prompt+generated, which must fit a bucket.
+        ecfg = dict(_engine_config(args), max_queue=256,
+                    prompt_buckets=(16, 32, 64),
+                    max_new_tokens=max(32, args.new_tokens))
+        pool = ENGINE_POOL if mode == "combined" else "llm-decode"
+        if mode == "combined":
+            app = build_llm_app(ecfg, mode="combined", name="llm",
+                                autoscaling_config=None, num_replicas=2)
+        else:
+            app = build_llm_app(ecfg, mode="disaggregated", name="llm",
+                                num_prefill_replicas=1,
+                                num_decode_replicas=2)
+        serve.run(app, route_prefix="/llm").remote(
+            {"prompt": [1, 2, 3], "n": args.new_tokens}).result(
+                timeout=600)
+        fs0 = _fault_stats()
+        mig0 = _router_migrations("llm")
+
+        # Deterministic greedy references, recorded BEFORE any chaos.
+        probe_prompts = {"probe-a": [5, 9, 2, 11, 3],
+                         "probe-b": [17, 4, 8, 1, 13, 6]}
+        refs = {}
+        for pname, prompt in probe_prompts.items():
+            rec = {"ttft": None, "token_ids": []}
+            _sse_request(port, {"model": "llm", "prompt": prompt,
+                                "max_tokens": args.new_tokens,
+                                "stream": True}, {}, rec)
+            if rec.get("status") != 200 or not rec.get("done"):
+                raise RuntimeError(f"chaos reference stream failed: "
+                                   f"{rec}")
+            refs[pname] = list(rec["token_ids"])
+
+        records = []
+        t0 = time.perf_counter()
+        stop_at = t0 + args.chaos_duration
+
+        def probe_loop(pname):
+            prompt = probe_prompts[pname]
+            while time.perf_counter() < stop_at:
+                rec = {"kind": "probe", "probe": pname, "ttft": None,
+                       "token_ids": []}
+                records.append(rec)
+                _sse_request(port, {"model": "llm", "prompt": prompt,
+                                    "max_tokens": args.new_tokens,
+                                    "stream": True}, {}, rec)
+
+        def load_loop(i):
+            r = random.Random(1000 + i)
+            while time.perf_counter() < stop_at:
+                prompt = [r.randint(1, 30000)
+                          for _ in range(r.randint(4, 12))]
+                rec = {"kind": "load", "ttft": None}
+                records.append(rec)
+                _sse_request(port, {"model": "llm", "prompt": prompt,
+                                    "max_tokens": args.new_tokens,
+                                    "stream": True, "seed": i}, {}, rec)
+                time.sleep(r.expovariate(8.0))
+
+        threads = [threading.Thread(target=probe_loop, args=(p,))
+                   for p in probe_prompts]
+        threads += [threading.Thread(target=load_loop, args=(i,))
+                    for i in range(2)]
+        for th in threads:
+            th.start()
+
+        kills = []
+        killed = set()
+        for _ in range(n_kills):
+            # Space the kills so later ones can land on the REPLACEMENT
+            # the controller spawned for the earlier ones.
+            time.sleep(args.chaos_duration / (n_kills + 1))
+            deadline = time.perf_counter() + 30
+            while time.perf_counter() < deadline:
+                # Insist on a busy victim until the last 5 s of the
+                # window, then take any live replica.
+                busy_only = time.perf_counter() < deadline - 5
+                if _kill_one_replica(pool, killed, kills, t0,
+                                     require_busy=busy_only):
+                    break
+                time.sleep(0.25)
+        for th in threads:
+            th.join(timeout=300)
+
+        # Settle until the controller has detected every kill AND
+        # closed the replacement loop (time_to_replace per kill).
+        fs1 = _fault_stats()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            fs1 = _fault_stats()
+            if (fs1["replica_restarts_total"] -
+                    fs0["replica_restarts_total"]) >= len(kills) and \
+                    len(fs1["time_to_replace_s"]) >= \
+                    len(fs0["time_to_replace_s"]) + len(kills):
+                break
+            time.sleep(1.0)
+
+        admitted = [r for r in records if r.get("status") == 200]
+        lost = [r for r in admitted if not r.get("done")]
+        broken = [r for r in records
+                  if r.get("status") in (-1, -2)]
+        shed = [r for r in records if r.get("status") in (429, 503)]
+        probes = [r for r in admitted
+                  if r.get("kind") == "probe" and r.get("done")]
+        mismatched = [r for r in probes
+                      if r["token_ids"] != refs[r["probe"]]]
+        migrations = _router_migrations("llm") - mig0
+        restarts = (fs1["replica_restarts_total"] -
+                    fs0["replica_restarts_total"])
+        t_replace = [round(x, 3) for x in
+                     fs1["time_to_replace_s"]
+                     [len(fs0["time_to_replace_s"]):]]
+        out = {
+            "mode": mode,
+            "kills": kills,
+            "requests": len(records),
+            "admitted": len(admitted),
+            "shed": len(shed),
+            "transport_errors": len(broken),
+            "lost_admitted": len(lost),
+            "probe_streams": len(probes),
+            "probe_mismatches": len(mismatched),
+            "migrations_total": migrations,
+            "replica_restarts": restarts,
+            "time_to_replace_s": t_replace,
+            "ttft_s": _percentiles(
+                [r["ttft"] for r in admitted if r.get("ttft")],
+                ps=(50, 95, 99)),
+            "ttft_max_s": round(max(
+                [r["ttft"] for r in admitted if r.get("ttft")] or [0]),
+                3),
+            "zero_admitted_lost": not lost and not broken,
+            "bit_identical": bool(probes) and not mismatched,
+        }
+
+        if mode == "combined":
+            # Rolling restart THROUGH the drain path: redeploy the same
+            # app mid-load; every old replica is drained (not killed
+            # cold) and no in-flight request fails.
+            drain0 = list(fs1.get("drain_duration_s") or [])
+            rec2 = []
+            stop2 = time.perf_counter() + 8.0
+
+            def redeploy_load(i):
+                r = random.Random(2000 + i)
+                while time.perf_counter() < stop2:
+                    prompt = [r.randint(1, 30000)
+                              for _ in range(r.randint(4, 12))]
+                    rec = {"ttft": None}
+                    rec2.append(rec)
+                    _sse_request(port, {"model": "llm",
+                                        "prompt": prompt,
+                                        "max_tokens": args.new_tokens,
+                                        "stream": True}, {}, rec)
+                    time.sleep(r.expovariate(8.0))
+
+            ths2 = [threading.Thread(target=redeploy_load, args=(i,))
+                    for i in range(2)]
+            for th in ths2:
+                th.start()
+            time.sleep(1.0)
+            serve.run(app, route_prefix="/llm")
+            for th in ths2:
+                th.join(timeout=300)
+            deadline = time.time() + 60
+            drains = []
+            while time.time() < deadline:
+                drains = list(_fault_stats().get(
+                    "drain_duration_s") or [])[len(drain0):]
+                if len(drains) >= 2:
+                    break
+                time.sleep(1.0)
+            adm2 = [r for r in rec2 if r.get("status") == 200]
+            lost2 = [r for r in adm2 if not r.get("done")] + \
+                [r for r in rec2 if r.get("status") in (-1, -2)]
+            out["redeploy"] = {
+                "requests": len(rec2),
+                "admitted": len(adm2),
+                "lost_admitted": len(lost2),
+                "drained_replicas": len(drains),
+                "drain_duration_s": [round(d, 3) for d in drains],
+            }
+            assert not lost2, (
+                f"redeploy lost {len(lost2)} in-flight requests")
+            assert len(drains) >= 2, (
+                f"redeploy drained {len(drains)} replicas, wanted >=2")
+
+        print(json.dumps({"chaos": out}), flush=True)
+        serve.delete("llm")
+        if mode == "combined":
+            serve.delete(ENGINE_POOL)
+        else:
+            serve.delete("llm-prefill")
+            serve.delete("llm-decode")
+
+        assert len(kills) >= 2, f"only {len(kills)} kills landed"
+        assert out["zero_admitted_lost"], (
+            f"lost admitted requests: {len(lost)} incomplete, "
+            f"{len(broken)} transport errors")
+        assert out["bit_identical"], (
+            f"{len(mismatched)}/{len(probes)} probe streams diverged "
+            "from the pre-chaos greedy reference")
+        assert migrations >= 1, "no request migration was observed"
+        assert restarts >= len(kills), (
+            f"controller detected {restarts} deaths for "
+            f"{len(kills)} kills")
+        assert len(t_replace) >= len(kills), (
+            f"time_to_replace recorded {len(t_replace)} replacements "
+            f"for {len(kills)} kills")
+        modes_out.append(out)
+
+    return {"metric": "llm_serve_chaos",
+            "new_tokens": args.new_tokens,
+            "kills_per_mode": n_kills,
+            "chaos_duration_s": args.chaos_duration,
+            "modes": modes_out}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="all",
                     choices=["all", "engine", "baseline", "probe",
-                             "handle-ab", "open-loop", "long-context"])
+                             "handle-ab", "open-loop", "long-context",
+                             "chaos"])
     ap.add_argument("--sessions", type=int, default=1000)
     ap.add_argument("--duration", type=float, default=15.0,
                     help="load-phase seconds per mode")
@@ -776,6 +1092,12 @@ def main():
                     help="admitted-request p99 TTFT bound for the "
                          "graceful-saturation verdict")
     ap.add_argument("--http-port", type=int, default=18640)
+    # --- chaos (fault-tolerance) bench ---------------------------------
+    ap.add_argument("--chaos-duration", type=float, default=20.0,
+                    help="seconds of SSE load per serving mode during "
+                         "which replicas are SIGKILLed")
+    ap.add_argument("--chaos-kills", type=int, default=2,
+                    help="replica SIGKILLs per serving mode (min 2)")
     ap.add_argument("--long-context-len", type=int, default=1024)
     ap.add_argument("--long-context-prompt", type=int, default=700)
     ap.add_argument("--out", default="",
@@ -787,6 +1109,7 @@ def main():
     from ray_tpu.serve.llm import build_llm_app
 
     open_loop = args.mode in ("all", "open-loop")
+    http_needed = open_loop or args.mode == "chaos"
     ingress_cfg = {
         # Admit roughly what the engine can HOLD at
         # bounded TTFT (slots + ~1 wave of queue); streams
@@ -796,7 +1119,7 @@ def main():
         "serve_ingress_queue_watermark": 16,
         "serve_ingress_queue_timeout_s": 1.5,
         "serve_ingress_executor_threads": 64,
-    } if open_loop else None
+    } if http_needed else None
     cluster = None
     if args.proxies > 1:
         # One ingress proxy per node: an N-proxy front door needs an
@@ -813,11 +1136,15 @@ def main():
     else:
         ray_tpu.init(num_cpus=8, object_store_memory=512 * 1024 * 1024,
                      _system_config=ingress_cfg)
-    serve.start(http_port=args.http_port if open_loop else None)
+    serve.start(http_port=args.http_port if http_needed else None)
     results = []
     opts = {"num_tpus": args.num_tpus_per_replica} \
         if args.num_tpus_per_replica else None
     try:
+        if args.mode == "chaos":
+            results.append(run_chaos(args))
+            print(json.dumps(results[-1]), flush=True)
+
         if args.mode in ("all", "long-context"):
             results.append(run_long_context(args))
             print(json.dumps(results[-1]), flush=True)

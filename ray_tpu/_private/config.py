@@ -607,6 +607,35 @@ _d("serve_ingress_stream_item_timeout_s", 120.0,
    "replica generator surfaces as a terminated stream, not a "
    "forever-open socket.")
 
+# --- serve fault tolerance --------------------------------------------------
+_d("serve_request_max_migrations", 3,
+   "How many times one admitted request may be migrated to another "
+   "replica after a replica death / engine failure / drain before it is "
+   "shed with a typed 503 (RequestMigrationExhaustedError). Streaming "
+   "migrations rebuild the resume descriptor from tokens already "
+   "delivered client-side and continue at the next token — never a "
+   "duplicate, never a gap; unary calls are retried from scratch "
+   "(deterministic per-request sampling keys make both bit-identical).")
+_d("serve_drain_timeout_s", 10.0,
+   "Rolling-restart drain budget: a draining replica stops admitting "
+   "new requests and gets this long to finish its in-flight work before "
+   "the controller kills it; stragglers hand off through the same "
+   "migration path as a crash (client-side resume, bit-identical).")
+_d("serve_kv_adopt_timeout_s", 60.0,
+   "Bound on resolving a prefill->decode KV handoff in adopt_kv; "
+   "expiry raises typed KVAdoptTimeoutError (dead prefill replica) so "
+   "the disaggregated router re-runs prefill on a healthy replica "
+   "instead of failing the request.")
+_d("serve_fault_inject", "",
+   "Deterministic serve-tier fault injection for tests and chaos "
+   "benches, honored by the LLM engine (also settable per-engine via "
+   "EngineConfig.fault_inject, which is how it reaches replica "
+   "processes). 'step_error:after=N' raises from the Nth decode step "
+   "(exercises _poison -> resume-descriptor migration); "
+   "'die:after_tokens=N' hard-exits the process after N emitted tokens "
+   "(exercises the ActorDiedError migration path). Each spec fires "
+   "once per process. Empty disables.")
+
 # --- correctness tooling ----------------------------------------------------
 _d("lockdep_enabled", False,
    "Runtime lock-order witness (ray_tpu._private.lockdep): wrap every "

@@ -84,6 +84,15 @@ CONTROL_PLANE = (
     # wait doesn't just wedge one request, it shrinks the front door.
     "ray_tpu/serve/ingress/server.py",
     "ray_tpu/serve/ingress/admission.py",
+    # The serve fault-tolerance spine: the controller's reconcile/drain
+    # loops, the replica's drain wait, and the handle/migration resume
+    # path all run in daemon threads between a dying replica and its
+    # replacement — an unbounded wait here turns a crash the tier is
+    # built to absorb into a wedged request.
+    "ray_tpu/serve/controller.py",
+    "ray_tpu/serve/replica.py",
+    "ray_tpu/serve/handle.py",
+    "ray_tpu/serve/migration.py",
 )
 
 # The subset where a swallowed GangMemberDiedError / RayActorError turns

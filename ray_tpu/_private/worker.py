@@ -420,8 +420,29 @@ class _GcsChannel:
         except (protocol.ConnectionClosed, OSError):
             if self._closed or self._register_payload is None:
                 raise
-            conn2 = self._reconnect(conn)
-            return getattr(conn2, fn_name)(*args, **kwargs)
+            # Redial WINDOW, not a single attempt: a crashed GCS
+            # relaunching on the same port is unreachable for the few
+            # seconds its replacement takes to bind — one immediate
+            # redial only covers the already-back case and turned
+            # every restart into a spurious ConnectionClosed at the
+            # caller (in-flight get()s included). Bounded by the
+            # control-RPC budget so a GCS that STAYS dead still fails
+            # typed within ~gcs_rpc_timeout_s.
+            deadline = time.time() + float(config.gcs_rpc_timeout_s)
+            delay = 0.1
+            while True:
+                try:
+                    conn2 = self._reconnect(conn)
+                    return getattr(conn2, fn_name)(*args, **kwargs)
+                except (protocol.ConnectionClosed, OSError):
+                    if self._closed:
+                        raise
+                    conn = self._conn
+                    if time.time() >= deadline:
+                        raise
+                    time.sleep(min(delay,
+                                   max(0.0, deadline - time.time())))
+                    delay = min(delay * 2, 2.0)
 
     # Explicit opt-out from the default RPC bound, for requests the GCS
     # deliberately parks server-side (wait_for_objects with no user

@@ -156,10 +156,100 @@ class ServeOverloadedError(RayTpuError):
         self.reason = reason
         super().__init__(message)
 
+    def __reduce__(self):
+        # Keep retry_after_s/reason across the task-error pickle boundary
+        # (default Exception pickling only keeps ``args``).
+        return (type(self), (self.args[0] if self.args else "",),
+                {"retry_after_s": self.retry_after_s, "reason": self.reason})
+
 
 class KVCacheExhaustedError(RayTpuError):
     """The paged KV block pool (or the engine's KV byte budget) cannot
     hold this sequence: prompt + generation budget needs more blocks
     than the whole pool owns. Raised at ADMISSION — a clean, typed
     failure instead of an OOM mid-generation."""
+
+
+class EngineFailedError(RayTpuError):
+    """The serving engine failed (compiled-step poison) or was stopped
+    with this request still in flight.
+
+    NOT terminal for the request: ``descriptor`` is a durable resume
+    descriptor — ``{prompt, generated, seed, position, max_tokens}`` —
+    and resubmitting it to a healthy engine continues generation
+    bit-identically from position ``len(prompt) + len(generated)`` (the
+    recompute-preemption path proves the continuation: per-request
+    ``fold_in(seed, position)`` sampling keys make the token stream a
+    pure function of the sequence so far). The serve handle uses the
+    client-side token tally, not this descriptor, to rebuild the resume
+    request — never a duplicate, never a gap — but the descriptor makes
+    the failure self-describing for drain and observability paths.
+    ``reason`` is ``"step_failure"`` or ``"engine_stopped"``."""
+
+    def __init__(self, message: str = "engine failed", *,
+                 descriptor: Optional[dict] = None, reason: str = ""):
+        self.descriptor = dict(descriptor or {})
+        self.reason = reason
+        super().__init__(message)
+
+    def __reduce__(self):
+        # Default Exception pickling only keeps ``args`` — carry the
+        # descriptor across the task-error boundary explicitly.
+        return (type(self), (self.args[0] if self.args else "",),
+                {"descriptor": self.descriptor, "reason": self.reason})
+
+
+class ReplicaDrainingError(RayTpuError):
+    """The replica is draining (rolling restart / scale-down) and no
+    longer admits new requests or streams. The caller should re-pick a
+    healthy replica and resubmit — the serve handle does this
+    transparently."""
+
+    def __init__(self, message: str = "replica is draining", *,
+                 replica_id: str = ""):
+        self.replica_id = replica_id
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",),
+                {"replica_id": self.replica_id})
+
+
+class RequestMigrationExhaustedError(ServeOverloadedError):
+    """A request was migrated across replica deaths
+    ``serve_request_max_migrations`` times and still could not
+    complete. A shed, not a silent failure: the HTTP ingress maps it to
+    ``503 Service Unavailable`` with a ``Retry-After`` header (via the
+    ``http_status`` attribute the overload renderer honors)."""
+
+    def __init__(self, message: str = "request migration budget exhausted",
+                 *, retry_after_s: float = 1.0, migrations: int = 0):
+        super().__init__(message, retry_after_s=retry_after_s,
+                         reason="migration_exhausted")
+        self.http_status = 503
+        self.migrations = int(migrations)
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",),
+                {"retry_after_s": self.retry_after_s, "reason": self.reason,
+                 "http_status": self.http_status,
+                 "migrations": self.migrations})
+
+
+class KVAdoptTimeoutError(GetTimeoutError):
+    """``kv_transfer.adopt_kv`` could not resolve the handoff KV refs
+    within ``serve_kv_adopt_timeout_s`` — the prefill replica that owns
+    them is likely dead. Typed so the disaggregated router can classify
+    it and re-run prefill on another replica instead of failing the
+    request; inherits ``GetTimeoutError`` so untouched paths keep their
+    timeout semantics (the ingress already maps timeouts to 503)."""
+
+    def __init__(self, message: str = "KV handoff adoption timed out", *,
+                 timeout_s: float = 0.0):
+        self.timeout_s = float(timeout_s)
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",),
+                {"timeout_s": self.timeout_s})
 

@@ -28,6 +28,35 @@ _STATS_TIMEOUT_S = 2.0
 # (~6s busy) — long user requests must not look like death.
 _MAX_PROBE_MISSES = 30
 
+_metrics_lock = threading.Lock()
+_metrics: Optional[Dict[str, Any]] = None
+
+
+def _fault_metrics() -> Dict[str, Any]:
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Histogram
+
+            _metrics = {
+                "restarts": Counter(
+                    "serve_replica_restarts_total",
+                    "Replica deaths detected (pubsub or probe) that "
+                    "triggered a replacement spawn.",
+                    tag_keys=("deployment",)),
+                "drain": Histogram(
+                    "serve_drain_duration_seconds",
+                    "Rolling-restart drain duration per replica, from "
+                    "drain RPC issue to teardown.",
+                    tag_keys=("deployment",)),
+                "replace": Histogram(
+                    "serve_replica_time_to_replace_seconds",
+                    "Death detection to replacement replica answering "
+                    "its first stats probe.",
+                    tag_keys=("deployment",)),
+            }
+        return _metrics
+
 
 def _load_from_stats(s: dict) -> float:
     """A replica's routing/autoscaling load: plain deployments report
@@ -53,6 +82,9 @@ class _DeploymentState:
         # actor id hex — piggybacked on the replicas long-poll channel
         # so handles route with ZERO hot-path stats RPCs.
         self.pushed_stats: Dict[str, float] = {}
+        # monotonic timestamps of detected replica deaths whose
+        # replacement has not been spawned yet (time-to-replace clock).
+        self.death_pending: List[float] = []
 
 
 class ServeController:
@@ -66,6 +98,16 @@ class ServeController:
         self._deployments: Dict[str, _DeploymentState] = {}
         self._miss_counts: Dict[int, int] = {}
         self._dead_counts: Dict[int, int] = {}
+        # Replicas draining for a rolling restart / scale-down:
+        # {"name", "replica", "ref", "start", "deadline"} — reaped (and
+        # only then killed) by _reap_draining each reconcile tick.
+        self._draining: List[dict] = []
+        # id(replacement handle) -> (deployment, death detection ts):
+        # closed out at the replacement's first successful stats probe.
+        self._replacing: Dict[int, tuple] = {}
+        self._fault: Dict[str, Any] = {"restarts": 0,
+                                       "time_to_replace_s": [],
+                                       "drain_duration_s": []}
         self._lock = threading.RLock()
         self._running = True
         self._http_port = http_port
@@ -74,6 +116,12 @@ class ServeController:
         # Long-poll state: key -> monotonically increasing version.
         self._versions: Dict[str, int] = {}
         self._change_cv = threading.Condition()
+        try:
+            from ray_tpu.util.metrics import start_reporter
+
+            start_reporter()
+        except Exception:
+            pass
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True, name="serve-reconcile")
         self._thread.start()
@@ -160,32 +208,132 @@ class ServeController:
                         rid = getattr(r, "_actor_id", None)
                         if rid is not None and rid.hex() == aid:
                             st.replicas.remove(r)
-                            changed.append((name, st))
-            for name, st in changed:
+                            changed.append((name, st, r))
+            for name, st, r in changed:
+                self._note_replica_death(name, st, r)
                 self._bump(f"replicas:{name}")
                 try:
                     self._scale_to_target(name, st)
                 except Exception:
                     pass
 
+    def _note_replica_death(self, name: str, st: _DeploymentState,
+                            replica: Any):
+        """Fault accounting at death DETECTION (pubsub or probe path):
+        starts the time-to-replace clock and counts the restart. If the
+        dead replica was itself a pending replacement, its clock is
+        dropped — the new spawn measures from THIS death."""
+        now = time.monotonic()
+        with self._lock:
+            self._replacing.pop(id(replica), None)
+            st.death_pending.append(now)
+            self._fault["restarts"] += 1
+        try:
+            _fault_metrics()["restarts"].inc(1, {"deployment": name})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- draining
+
+    def _begin_drain(self, name: str, replicas: List[Any]):
+        """Rolling-restart path: ask each replica to drain (stop
+        admitting, finish in-flight) and park it on the draining list.
+        The reconcile loop reaps + kills it when the drain RPC returns
+        or the budget expires — deploy()/scale-down never block, and
+        stragglers past the budget hand off through the same migration
+        path as a crash when the kill lands."""
+        from ray_tpu._private.config import config
+
+        timeout_s = float(config.serve_drain_timeout_s)
+        now = time.monotonic()
+        for r in replicas:
+            try:
+                ref = r.drain.remote(timeout_s)
+            except Exception:
+                ref = None
+            with self._lock:
+                self._draining.append({
+                    "name": name, "replica": r, "ref": ref, "start": now,
+                    # Grace past the replica-side budget so the RPC
+                    # normally returns before the hard deadline fires.
+                    "deadline": now + timeout_s + 5.0,
+                })
+
+    def _reap_draining(self):
+        """Kill drained (or drain-deadline-expired) replicas; observe
+        drain duration. Called every reconcile tick — before the
+        no-deployments early return, so a deleted deployment's draining
+        replicas still get torn down."""
+        import ray_tpu
+
+        with self._lock:
+            entries = list(self._draining)
+        if not entries:
+            return
+        refs = [e["ref"] for e in entries if e["ref"] is not None]
+        ready_set = set()
+        if refs:
+            try:
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=0.05)
+                ready_set = {id(x) for x in ready}
+            except Exception:
+                pass
+        now = time.monotonic()
+        for e in entries:
+            if e["ref"] is not None and id(e["ref"]) not in ready_set \
+                    and now < e["deadline"]:
+                continue
+            with self._lock:
+                try:
+                    self._draining.remove(e)
+                except ValueError:
+                    continue
+            dur = time.monotonic() - e["start"]
+            with self._lock:
+                self._fault["drain_duration_s"].append(dur)
+            try:
+                _fault_metrics()["drain"].observe(
+                    dur, {"deployment": e["name"]})
+            except Exception:
+                pass
+            self._kill_replicas([e["replica"]])
+
+    def fault_stats(self) -> Dict[str, Any]:
+        """Fault-tolerance observability for the chaos bench: restart
+        count, per-replacement time-to-replace samples, per-replica
+        drain durations, and how many replicas are currently
+        draining."""
+        with self._lock:
+            return {
+                "replica_restarts_total": int(self._fault["restarts"]),
+                "time_to_replace_s": list(
+                    self._fault["time_to_replace_s"]),
+                "drain_duration_s": list(
+                    self._fault["drain_duration_s"]),
+                "draining": len(self._draining),
+            }
+
     # ----------------------------------------------------------- deploy API
 
     def deploy(self, config: dict, callable_blob: bytes, init_args,
                init_kwargs) -> bool:
-        with self._lock:
-            existing = self._deployments.get(config["name"])
-            self._deployments[config["name"]] = _DeploymentState(
-                config, callable_blob, init_args, init_kwargs)
-            if existing is not None:
-                # Replace: old replicas torn down by reconcile (code push).
-                self._deployments[config["name"]].replicas = []
-                self._kill_replicas(existing.replicas)
         name = config["name"]
         with self._lock:
-            st = self._deployments[name]
+            existing = self._deployments.get(name)
+            st = _DeploymentState(config, callable_blob, init_args,
+                                  init_kwargs)
+            self._deployments[name] = st
+        # Rolling restart: spawn the NEW generation first, repoint the
+        # long-poll channel at it, and only then drain the old replicas
+        # — their in-flight requests finish (or hand off through the
+        # crash-migration path when the drain budget expires) while new
+        # traffic already lands on the replacement generation.
         self._scale_to_target(name, st)
         self._bump(f"replicas:{name}")
         self._bump("routes")
+        if existing is not None:
+            self._begin_drain(name, existing.replicas)
         return True
 
     def delete_deployment(self, name: str) -> bool:
@@ -226,6 +374,8 @@ class ServeController:
             for st in self._deployments.values():
                 self._kill_replicas(st.replicas)
             self._deployments.clear()
+            self._kill_replicas([e["replica"] for e in self._draining])
+            self._draining.clear()
             proxies = [info["actor"] for info in self._proxies.values()]
             self._proxies.clear()
         for p in proxies:
@@ -258,6 +408,10 @@ class ServeController:
             self._reconcile_proxies()
         except Exception:
             pass
+        try:
+            self._reap_draining()
+        except Exception:
+            pass
         with self._lock:
             items = list(self._deployments.items())
         if not items:
@@ -285,6 +439,21 @@ class ServeController:
                     stats_by_replica[key] = ray_tpu.get(ref, timeout=1)
                     self._miss_counts.pop(key, None)
                     self._dead_counts.pop(key, None)
+                    # First successful probe of a replacement replica
+                    # closes the time-to-replace clock opened at its
+                    # predecessor's death detection.
+                    with self._lock:
+                        pending = self._replacing.pop(key, None)
+                    if pending is not None:
+                        dep_name, death_ts = pending
+                        dt = time.monotonic() - death_ts
+                        with self._lock:
+                            self._fault["time_to_replace_s"].append(dt)
+                        try:
+                            _fault_metrics()["replace"].observe(
+                                dt, {"deployment": dep_name})
+                        except Exception:
+                            pass
                     continue
                 except (ray_tpu.exceptions.RayActorError,
                         ray_tpu.exceptions.WorkerCrashedError):
@@ -305,11 +474,14 @@ class ServeController:
             if dead or self._miss_counts[key] >= _MAX_PROBE_MISSES:
                 self._miss_counts.pop(key, None)
                 self._dead_counts.pop(key, None)
+                removed = False
                 with self._lock:
                     if r in st.replicas:
                         st.replicas.remove(r)
-                        self._bump(
-                            f"replicas:{st.config['name']}")
+                        removed = True
+                if removed:
+                    self._note_replica_death(st.config["name"], st, r)
+                    self._bump(f"replicas:{st.config['name']}")
                 self._kill_replicas([r])
 
         now = time.time()
@@ -399,11 +571,18 @@ class ServeController:
                 user_config=st.config.get("user_config"))
             with self._lock:
                 st.replicas.append(handle)
+                if st.death_pending:
+                    # This spawn replaces a detected death: its first
+                    # successful stats probe closes the clock.
+                    self._replacing[id(handle)] = (
+                        name, st.death_pending.pop(0))
         if deficit < 0:
             with self._lock:
                 extra, st.replicas = (st.replicas[st.target:],
                                       st.replicas[:st.target])
-            self._kill_replicas(extra)
+            # Scale-down reuses the rolling-restart path: drain, then
+            # kill on reap — in-flight work finishes or migrates.
+            self._begin_drain(name, extra)
         if deficit:
             self._bump(f"replicas:{name}")
 

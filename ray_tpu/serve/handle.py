@@ -40,11 +40,22 @@ def _aid(replica) -> str:
         return str(id(replica))
 
 
+def _note_migration_quiet(deployment: str) -> None:
+    try:
+        from ray_tpu.serve.migration import note_migration
+
+        note_migration(deployment)
+    except Exception:
+        pass
+
+
 class DeploymentResponse:
-    def __init__(self, ref, resubmit=None, on_done=None, span=None):
+    def __init__(self, ref, resubmit=None, on_done=None, span=None,
+                 deployment: str = ""):
         self._ref = ref
         self._resubmit = resubmit
         self._on_done = on_done
+        self._deployment = deployment
         # The handle-root PendingSpan: emitted once, when the OUTCOME is
         # known (here, at result()) — an errored request's trace is then
         # always kept even when head-based sampling dropped it.
@@ -52,14 +63,21 @@ class DeploymentResponse:
 
     def result(self, timeout: Optional[float] = None):
         """Block for the response. If the serving replica died
-        (controller replacement, node loss), the request is resubmitted to
-        a live replica up to 3 times (reference: the serve router requeues
-        requests from dead replicas — at-least-once on replica death).
-        """
+        (controller replacement, node loss), its engine failed with the
+        request in flight, or the replica is draining for a rolling
+        restart, the request is resubmitted to a live replica up to
+        ``serve_request_max_migrations`` times (reference: the serve
+        router requeues requests from dead replicas). A unary rerun is
+        bit-identical — nothing was delivered yet and per-request
+        sampling keys are deterministic. An exhausted budget sheds
+        typed (``RequestMigrationExhaustedError`` — the ingress maps it
+        to 503)."""
         import ray_tpu
         from ray_tpu import exceptions
+        from ray_tpu._private.config import config
 
-        attempts = 3
+        limit = max(0, int(config.serve_request_max_migrations))
+        migrations = 0
         try:
             while True:
                 try:
@@ -67,13 +85,24 @@ class DeploymentResponse:
                     self._finish_span("ok")
                     return out
                 except (exceptions.RayActorError,
-                        exceptions.WorkerCrashedError):
-                    if self._resubmit is None or attempts <= 0:
+                        exceptions.WorkerCrashedError,
+                        exceptions.ReplicaDrainingError,
+                        exceptions.EngineFailedError) as e:
+                    if self._resubmit is None:
                         self._finish_span("error")
                         raise
-                    attempts -= 1
-                    time.sleep(0.2)
+                    if migrations >= limit:
+                        self._finish_span("error")
+                        raise exceptions.RequestMigrationExhaustedError(
+                            f"request still failing after {migrations} "
+                            f"migrations (serve_request_max_migrations="
+                            f"{limit})", migrations=migrations) from e
+                    migrations += 1
+                    # Small backoff: the controller needs a beat to
+                    # prune the dead replica from the pushed set.
+                    time.sleep(0.2 * migrations)
                     self._ref = self._resubmit()
+                    _note_migration_quiet(self._deployment)
                 except exceptions.GetTimeoutError:
                     raise   # not terminal: the caller may result() again
                 except BaseException:
@@ -125,7 +154,7 @@ class DeploymentResponseGenerator:
 
     def __init__(self, replica, stream_id: str,
                  timeout_s: Optional[float] = None, on_done=None,
-                 span=None):
+                 span=None, handle=None, resume=None):
         self._replica = replica
         self._sid = stream_id
         self._timeout = timeout_s
@@ -135,49 +164,117 @@ class DeploymentResponseGenerator:
         self._exhausted = False
         self._buf: List[Any] = []
         self._done_after_buf = False
+        # Crash-transparent migration: the opening handle plus a
+        # ``resume(delivered) -> (method, args, kwargs) | None`` rewriter
+        # that rebuilds the request from the items already received
+        # client-side (the authoritative no-duplicate/no-gap tally).
+        self._handle = handle
+        self._resume = resume
+        self._delivered: List[Any] = []
+        self._migrations = 0
 
     def __iter__(self):
         return self
 
     def __next__(self):
         import ray_tpu
+        from ray_tpu import exceptions
 
-        if self._buf:
-            return self._buf.pop(0)
-        if self._exhausted:
-            raise StopIteration
-        if self._done_after_buf:
-            self._finish("ok")
-            raise StopIteration
-        try:
-            out = ray_tpu.get(
-                self._replica.stream_next.remote(self._sid,
-                                                 self._MAX_ITEMS),
-                timeout=self._timeout)
-        except BaseException:
-            # Tell the replica before marking ourselves exhausted: a
-            # CLIENT-side failure (per-item timeout, interrupt) is one
-            # the replica cannot see — without the cancel its stream
-            # entry, ongoing count, and the engine request behind it
-            # would live on for a consumer that is gone. (If the error
-            # CAME from the replica it already dropped the stream and
-            # the cancel is a cheap no-op.)
-            self._status = "error"
-            self.cancel()
-            raise
-        if "items" in out:
-            self._buf = list(out["items"])
-            if out.get("done"):
-                # Deliver the trailing items first; stop after.
-                self._done_after_buf = True
+        while True:
             if self._buf:
                 return self._buf.pop(0)
-            self._finish("ok")
-            raise StopIteration
-        if out.get("done"):
-            self._finish("ok")
-            raise StopIteration
-        return out["item"]
+            if self._exhausted:
+                raise StopIteration
+            if self._done_after_buf:
+                self._finish("ok")
+                raise StopIteration
+            try:
+                out = ray_tpu.get(
+                    self._replica.stream_next.remote(self._sid,
+                                                     self._MAX_ITEMS),
+                    timeout=self._timeout)
+            except (exceptions.RayActorError,
+                    exceptions.WorkerCrashedError,
+                    exceptions.EngineFailedError) as e:
+                # The replica died (or its engine failed with a resume
+                # descriptor) mid-stream: migrate to a healthy replica
+                # and continue at the next item.
+                if self._try_migrate(e):
+                    continue
+                self._status = "error"
+                self.cancel()
+                raise
+            except BaseException:
+                # Tell the replica before marking ourselves exhausted: a
+                # CLIENT-side failure (per-item timeout, interrupt) is
+                # one the replica cannot see — without the cancel its
+                # stream entry, ongoing count, and the engine request
+                # behind it would live on for a consumer that is gone.
+                # (If the error CAME from the replica it already dropped
+                # the stream and the cancel is a cheap no-op.)
+                self._status = "error"
+                self.cancel()
+                raise
+            if "items" in out:
+                self._buf = list(out["items"])
+                self._delivered.extend(self._buf)
+                if out.get("done"):
+                    # Deliver the trailing items first; stop after.
+                    self._done_after_buf = True
+                if self._buf:
+                    return self._buf.pop(0)
+                self._finish("ok")
+                raise StopIteration
+            if out.get("done"):
+                self._finish("ok")
+                raise StopIteration
+            self._delivered.append(out["item"])
+            return out["item"]
+
+    def _try_migrate(self, err: BaseException) -> bool:
+        """Re-open the stream on a healthy replica, resuming after the
+        items already delivered. Returns False when migration is not
+        wired (no ``resume`` rewriter — generic streams keep today's
+        fail-loud behavior) or the rewriter declines; raises typed when
+        the ``serve_request_max_migrations`` budget is exhausted."""
+        from ray_tpu import exceptions
+        from ray_tpu._private.config import config
+
+        if self._resume is None or self._handle is None:
+            return False
+        limit = max(0, int(config.serve_request_max_migrations))
+        if self._migrations >= limit:
+            self._status = "error"
+            self.cancel()
+            raise exceptions.RequestMigrationExhaustedError(
+                f"stream still failing after {self._migrations} "
+                f"migrations (serve_request_max_migrations={limit})",
+                migrations=self._migrations) from err
+        try:
+            call = self._resume(list(self._delivered))
+        except Exception:
+            call = None
+        if call is None:
+            return False
+        method, args, kwargs = call
+        try:
+            replica, sid, done = self._handle._open_stream(
+                method, args, kwargs, span=self._span, fresh=True)
+        except BaseException:
+            # Could not place the resume anywhere before the stream-open
+            # deadline; surface the ORIGINAL death to the caller.
+            return False
+        self._migrations += 1
+        _note_migration_quiet(self._handle.deployment_name)
+        old_done, self._on_done = self._on_done, done
+        if old_done is not None:
+            try:
+                old_done()
+            except Exception:
+                pass
+        self._replica = replica
+        self._sid = sid
+        return True
 
     def cancel(self):
         """Abandon the stream (replica-side generator is closed)."""
@@ -324,7 +421,7 @@ class DeploymentHandle:
                 return
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
         replicas = ray_tpu.get(
-            ctrl.get_replicas.remote(self.deployment_name))
+            ctrl.get_replicas.remote(self.deployment_name), timeout=30)
         with self._lock:
             self._replicas = replicas
             self._fetched_at = now
@@ -420,43 +517,96 @@ class DeploymentHandle:
             resubmit=lambda: self._submit(self._method, args, kwargs,
                                           fresh=True, span=span)[0],
             on_done=done,
-            span=span)
+            span=span,
+            deployment=self.deployment_name)
 
     def remote_gen(self, *args, _item_timeout_s: Optional[float] = None,
-                   **kwargs) -> DeploymentResponseGenerator:
+                   _resume=None, **kwargs) -> DeploymentResponseGenerator:
         """Streaming call. ``_item_timeout_s`` (underscored so it can
         never collide with user kwargs) bounds EACH item pull — the
         ingress tier sets it so a wedged replica generator terminates
-        the stream instead of parking a proxy thread forever."""
+        the stream instead of parking a proxy thread forever.
+        ``_resume`` is an optional migration rewriter
+        (``resume(delivered) -> (method, args, kwargs) | None``, see
+        ray_tpu.serve.migration): with it, a replica death mid-stream
+        re-opens on a healthy replica and continues at the next item."""
         return self._submit_stream(self._method, args, kwargs,
-                                   item_timeout_s=_item_timeout_s)
+                                   item_timeout_s=_item_timeout_s,
+                                   resume=_resume)
+
+    def _open_stream(self, method: str, args, kwargs, span=None,
+                     fresh: bool = False):
+        """Pick a replica and open a stream on it. A pick that lands on
+        a dead or draining replica retries against a force-refreshed
+        set (bounded by the stream-start timeout) — replica churn at
+        open time, including every stream migration's re-open, rides
+        this. Returns ``(replica, stream_id, done_callback)``."""
+        import ray_tpu
+        from ray_tpu import exceptions
+
+        deadline = time.time() + _STREAM_START_TIMEOUT_S
+        while True:
+            if fresh:
+                self._refresh(force=True)
+            replica = self._pick()
+            done = self._note_submit(replica)
+            try:
+                if span is not None:
+                    with span.active():
+                        start_ref = replica.handle_request_stream.remote(
+                            method, args, kwargs)
+                else:
+                    start_ref = replica.handle_request_stream.remote(
+                        method, args, kwargs)
+                sid = ray_tpu.get(start_ref,
+                                  timeout=_STREAM_START_TIMEOUT_S)
+                return replica, sid, done
+            except (exceptions.RayActorError,
+                    exceptions.WorkerCrashedError):
+                # The request moved off a CRASHED replica — that is a
+                # migration (counted), even though open-retries do not
+                # consume the per-stream migration budget: nothing was
+                # delivered yet, so the retry is trivially exact.
+                done()
+                _note_migration_quiet(self.deployment_name)
+                fresh = True
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
+            except exceptions.ReplicaDrainingError:
+                # Admission shed on a retiring replica: a re-pick, not
+                # a crash migration — kept out of the counter.
+                done()
+                fresh = True
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
+            except BaseException:
+                done()
+                raise
 
     def _submit_stream(self, method: str, args, kwargs,
-                       item_timeout_s: Optional[float] = None
-                       ) -> DeploymentResponseGenerator:
-        import ray_tpu
+                       item_timeout_s: Optional[float] = None,
+                       resume=None) -> DeploymentResponseGenerator:
         from ray_tpu.util import tracing
 
-        replica = self._pick()
-        done = self._note_submit(replica)
         span = tracing.PendingSpan(
             f"serve.handle.{self.deployment_name}.{method}",
             kind="serve_handle",
             attrs={"deployment": self.deployment_name,
                    "method": method, "streaming": True})
         try:
-            with span.active():
-                start_ref = replica.handle_request_stream.remote(
-                    method, args, kwargs)
-            sid = ray_tpu.get(start_ref, timeout=_STREAM_START_TIMEOUT_S)
+            replica, sid, done = self._open_stream(method, args, kwargs,
+                                                   span=span)
         except BaseException:
-            done()
             span.finish("error")
             raise
         return DeploymentResponseGenerator(replica, sid,
                                            timeout_s=item_timeout_s,
                                            on_done=done,
-                                           span=span)
+                                           span=span,
+                                           handle=self,
+                                           resume=resume)
 
 
 class _MethodCaller:
@@ -471,9 +621,11 @@ class _MethodCaller:
             resubmit=lambda: self._handle._submit(
                 self._method, args, kwargs, fresh=True, span=span)[0],
             on_done=done,
-            span=span)
+            span=span,
+            deployment=self._handle.deployment_name)
 
     def remote_gen(self, *args, _item_timeout_s: Optional[float] = None,
-                   **kwargs) -> DeploymentResponseGenerator:
+                   _resume=None, **kwargs) -> DeploymentResponseGenerator:
         return self._handle._submit_stream(
-            self._method, args, kwargs, item_timeout_s=_item_timeout_s)
+            self._method, args, kwargs, item_timeout_s=_item_timeout_s,
+            resume=_resume)

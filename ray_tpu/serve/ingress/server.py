@@ -240,8 +240,14 @@ class HTTPProxy:
         return self._bound_port
 
     def ingress_stats(self) -> Dict[str, Any]:
+        from ray_tpu.serve.migration import migration_stats
+
         adm = self._admission
-        return dict(adm.stats()) if adm is not None else {}
+        out = dict(adm.stats()) if adm is not None else {}
+        # Streams opened BY this proxy migrate in this process — the
+        # chaos bench sums these with the router replicas' tallies.
+        out.update(migration_stats())
+        return out
 
     # --------------------------------------------------------------- server
 
@@ -334,11 +340,24 @@ class HTTPProxy:
         """(status, payload) for a data-path failure — typed, not a
         blanket 500."""
         from ray_tpu.exceptions import (
-            GetTimeoutError, ServeOverloadedError,
+            GetTimeoutError, RayActorError, ReplicaDrainingError,
+            ServeOverloadedError, WorkerCrashedError,
         )
 
         if isinstance(e, ServeOverloadedError):
-            return None   # caller renders 429 + Retry-After
+            # Includes RequestMigrationExhaustedError (http_status 503):
+            # caller renders via _overload_response + Retry-After.
+            return None
+        if isinstance(e, ReplicaDrainingError):
+            # Raced a rolling restart past the handle's retry budget:
+            # retryable, never a 500.
+            return 503, {"error": {"type": "draining", "message": str(e)}}
+        if isinstance(e, (RayActorError, WorkerCrashedError)):
+            # Replica death the migration path could not absorb (e.g.
+            # non-resumable request): the replacement replica is already
+            # spawning — tell the client to retry, not that we broke.
+            return 503, {"error": {"type": "replica_unavailable",
+                                   "message": str(e)}}
         if isinstance(e, (GetTimeoutError, asyncio.TimeoutError,
                           concurrent.futures.TimeoutError,
                           TimeoutError)):
@@ -481,9 +500,16 @@ class HTTPProxy:
             # START, and a shed must be a real 429/Retry-After the
             # client can act on — not an error frame inside a
             # success-status SSE body.
+            # The resume rewriter makes a router-replica death mid-SSE
+            # invisible: the handle re-opens generate_stream on a
+            # healthy replica with ``generated`` = every token already
+            # delivered, and the SSE continues at the next token.
+            from ray_tpu.serve.migration import llm_stream_resume
+
             def start_stream():
                 return handle.generate_stream.remote_gen(
-                    req, _item_timeout_s=self._stream_item_timeout_s)
+                    req, _item_timeout_s=self._stream_item_timeout_s,
+                    _resume=llm_stream_resume(req))
 
             loop = asyncio.get_running_loop()
             inner = loop.run_in_executor(self._pool, start_stream)

@@ -77,6 +77,10 @@ class EngineConfig:
     # --- prefill micro-batching (PrefillReplica) ----------------------
     prefill_batch_size: int = 1       # 1 = one prompt per program call
     prefill_batch_window_ms: float = 2.0
+    # --- deterministic fault injection (tests / chaos bench) ----------
+    fault_inject: str = ""            # "" = config.serve_fault_inject;
+    #                                   "step_error:after=N" |
+    #                                   "die:after_tokens=N"
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "EngineConfig":
@@ -184,6 +188,28 @@ def engine_metrics() -> Dict[str, Any]:
         return _metrics
 
 
+def _parse_fault_inject(spec: str) -> Optional[Dict[str, Any]]:
+    """Parse a fault-injection spec: ``action:key=int[,key=int]``.
+    Unknown actions raise at engine init — a typo must not silently
+    disable chaos coverage. Each spec fires at most once."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    action, _, rest = spec.partition(":")
+    action = action.strip()
+    if action not in ("step_error", "die"):
+        raise ValueError(
+            f"unknown serve_fault_inject action {action!r} "
+            "(expected 'step_error' or 'die')")
+    out: Dict[str, Any] = {"action": action, "fired": False, "count": 0}
+    for part in (p.strip() for p in rest.split(",")):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = int(v)
+    return out
+
+
 class _Request:
     __slots__ = ("id", "kind", "prompt", "budget", "seed", "kv",
                  "first_token", "true_len", "tokens", "cursor", "done",
@@ -289,6 +315,16 @@ class InflightBatchEngine:
         self._requests: Dict[str, _Request] = {}
         self._stopped = False
         self._steps = 0
+        # Deterministic fault injection: per-engine knob wins (it is how
+        # the spec reaches replica processes, which do not inherit the
+        # driver's system config); the global knob covers same-process
+        # engines in tests.
+        fault_spec = engine_cfg.fault_inject
+        if not fault_spec:
+            from ray_tpu._private.config import config as _global_cfg
+
+            fault_spec = str(_global_cfg.serve_fault_inject or "")
+        self._fault = _parse_fault_inject(fault_spec)
         # Prefix-cache accounting (scheduler thread writes; stats()
         # readers tolerate a torn int read).
         self._prefix_hit_tokens = 0
@@ -374,17 +410,42 @@ class InflightBatchEngine:
 
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               seed: int = 0) -> str:
-        """Queue a raw prompt; returns a request id for drain/collect."""
+               seed: int = 0,
+               generated: Optional[Sequence[int]] = None) -> str:
+        """Queue a raw prompt; returns a request id for drain/collect.
+
+        ``generated`` resumes a migrated request: the tokens another
+        engine already produced (and the caller already delivered).
+        The engine re-prefills ``prompt + generated`` and continues at
+        position ``len(prompt) + len(generated)`` — per-request
+        ``fold_in(seed, position)`` sampling keys make the continuation
+        bit-identical to the uninterrupted run (the recompute-preemption
+        invariant), and the resumed tokens are never re-delivered
+        (``drain``/``collect``/``stream`` start past them)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
+        generated = [int(t) for t in generated] if generated else []
         if self._pool is None:
-            self._bucket_for(len(prompt))  # validate against buckets now
+            # The (re-)prefilled sequence must fit a bucket.
+            self._bucket_for(len(prompt) + len(generated))
         budget = self._check_budget(len(prompt), max_new_tokens)
+        if generated and len(generated) >= budget:
+            raise ValueError(
+                f"resume carries {len(generated)} generated tokens but "
+                f"the budget is {budget}: nothing left to generate")
         self._check_pool_fit(len(prompt) + budget)
-        return self._enqueue(_Request(
-            "prompt", prompt=prompt, budget=budget, seed=int(seed)))
+        req = _Request(
+            "prompt", prompt=prompt, budget=budget, seed=int(seed))
+        if generated:
+            # Preset the produced tokens as already-consumed: they ride
+            # full_sequence() (re-prefill, descriptors, preemption)
+            # but are invisible to drain/collect/stream.
+            req.tokens = generated
+            req.cursor = len(generated)
+            req.produced = len(generated)
+            req.resume_tokens = prompt + generated
+        return self._enqueue(req)
 
     def submit_prefilled(self, first_token: int, kv: Dict[str, Any],
                          true_len: int,
@@ -500,9 +561,11 @@ class InflightBatchEngine:
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: Optional[int] = None,
-                 seed: int = 0) -> List[int]:
+                 seed: int = 0,
+                 generated: Optional[Sequence[int]] = None) -> List[int]:
         """Blocking convenience: submit + drain to completion."""
-        rid = self.submit(prompt, max_new_tokens, seed)
+        rid = self.submit(prompt, max_new_tokens, seed,
+                          generated=generated)
         return list(itertools.chain.from_iterable(self.stream(rid)))
 
     # --------------------------------------------------------------- stats
@@ -535,12 +598,73 @@ class InflightBatchEngine:
                 self._prefill_tokens_computed
         return out
 
+    # ------------------------------------------------- resume descriptors
+
+    @staticmethod
+    def _descriptor(req: _Request) -> Dict[str, Any]:
+        """Durable resume descriptor of one in-flight request: enough to
+        resubmit it to any healthy engine and continue bit-identically
+        at position ``len(prompt) + len(generated)``."""
+        prompt = [int(t) for t in (req.prompt or [])]
+        generated: List[int] = []
+        if req.kind == "prefilled" and req.first_token is not None:
+            generated.append(int(req.first_token))
+        generated += [int(t) for t in req.tokens]
+        return {
+            "req_id": req.id,
+            "prompt": prompt,
+            "generated": generated,
+            "seed": int(req.seed),
+            "position": len(prompt) + len(generated),
+            "max_tokens": int(req.budget),
+            "delivered": int(req.cursor),
+        }
+
+    def _resume_error_locked(self, req: _Request, cause: BaseException,
+                             reason: str) -> BaseException:
+        """The typed, descriptor-carrying error an in-flight request
+        gets on engine failure/stop — durable and migratable, not
+        terminal. A prefilled handoff that carried no prompt cannot be
+        recomputed; it keeps the raw cause."""
+        if req.prompt is None:
+            return cause
+        try:
+            from ray_tpu.exceptions import EngineFailedError
+
+            return EngineFailedError(
+                f"engine {reason} with request {req.id} in flight "
+                f"({cause!r}); resume descriptor attached",
+                descriptor=self._descriptor(req), reason=reason)
+        except Exception:
+            # Interpreter teardown (__del__-driven stop): keep the cause.
+            return cause
+
+    def dump_inflight(self) -> List[Dict[str, Any]]:
+        """Resume descriptors of every live, recomputable request —
+        queued, prefilling, or decoding — plus those already holding an
+        unconsumed descriptor-carrying error. The drain/observability
+        view of what a dying replica would owe its callers."""
+        from ray_tpu.exceptions import EngineFailedError
+
+        out: List[Dict[str, Any]] = []
+        with self._cv:
+            for req in self._requests.values():
+                if req.done or req.cancelled or req.prompt is None:
+                    continue
+                if req.error is not None and \
+                        not isinstance(req.error, EngineFailedError):
+                    continue
+                out.append(self._descriptor(req))
+        return out
+
     def stop(self) -> None:
         with self._cv:
             self._stopped = True
             for req in self._requests.values():
                 if not req.done and req.error is None:
-                    req.error = RuntimeError("engine stopped")
+                    req.error = self._resume_error_locked(
+                        req, RuntimeError("engine stopped"),
+                        "engine_stopped")
             self._cv.notify_all()
         self._thread.join(timeout=_STOP_JOIN_S)
         # Zero the gauges AFTER the scheduler thread exits (an
@@ -555,6 +679,36 @@ class InflightBatchEngine:
             if self._pool is not None:
                 self._m["kv_occupancy"].set(0, self._tags)
                 self._m["kv_shared_blocks"].set(0, self._tags)
+
+    # ------------------------------------------------------ fault injection
+
+    def _fault_step_tick(self) -> None:
+        """``step_error:after=N``: the Nth decode step with live work
+        raises — exercising ``_poison`` and the descriptor-carrying
+        migration path deterministically. Fires once."""
+        f = self._fault
+        if f is None or f["fired"] or f["action"] != "step_error":
+            return
+        f["count"] += 1
+        if f["count"] >= f.get("after", 1):
+            f["fired"] = True
+            raise RuntimeError(
+                f"fault injection: step_error at decode step "
+                f"{f['count']}")
+
+    def _fault_token_tick(self, emitted: int) -> None:
+        """``die:after_tokens=N``: hard-exit the process once N tokens
+        have been emitted — a deterministic SIGKILL stand-in exercising
+        the ActorDiedError migration path."""
+        f = self._fault
+        if f is None or f["fired"] or f["action"] != "die":
+            return
+        f["count"] += emitted
+        if f["count"] >= f.get("after_tokens", 1):
+            f["fired"] = True
+            import os
+
+            os._exit(1)
 
     # ----------------------------------------------------------- scheduler
 
@@ -584,12 +738,16 @@ class InflightBatchEngine:
                         self._cv.wait(_IDLE_WAIT_S)
 
     def _poison(self, err: BaseException) -> None:
-        """A scheduler-side failure fails every in-flight request (the
-        callers see the real error) instead of wedging the loop."""
+        """A scheduler-side failure fails every in-flight request
+        instead of wedging the loop — but not terminally: each
+        recomputable request's error is an ``EngineFailedError``
+        carrying its resume descriptor, so the serve handle migrates it
+        to a healthy replica and the client never sees the blip."""
         with self._cv:
             for req in list(self._requests.values()):
                 if not req.done and req.error is None:
-                    req.error = err
+                    req.error = self._resume_error_locked(
+                        req, err, "step_failure")
             self._pending.clear()
             self._m["queue_depth"].set(0, self._tags)
             for i in range(len(self._slot_req)):
@@ -642,16 +800,22 @@ class InflightBatchEngine:
         for slot, req in take:
             try:
                 if req.kind == "prompt":
-                    bucket = self._bucket_for(len(req.prompt))
+                    # A resume (migrated request) re-prefills
+                    # prompt + generated; the sampled token is then the
+                    # continuation at the same counter the uninterrupted
+                    # decode would have used.
+                    seq = req.resume_tokens \
+                        if req.resume_tokens is not None else req.prompt
+                    bucket = self._bucket_for(len(seq))
                     padded = self._np.zeros((1, bucket), self._np.int32)
-                    padded[0, :len(req.prompt)] = req.prompt
+                    padded[0, :len(seq)] = seq
                     first, kv = prefill_slot(
                         self._params, jnp.asarray(padded),
-                        jnp.int32(len(req.prompt)), jnp.int32(req.seed),
+                        jnp.int32(len(seq)), jnp.int32(req.seed),
                         cfg=self._cfg, temperature=self._ec.temperature,
                         top_k=self._ec.top_k)
                     first_token = int(first[0])
-                    true_len = len(req.prompt)
+                    true_len = len(seq)
                     emit_first = True
                 else:
                     kv = {"k": jnp.asarray(req.kv["k"]),
@@ -671,20 +835,22 @@ class InflightBatchEngine:
             self._last_tokens[slot] = first_token
             self._seeds[slot] = req.seed
             self._active[slot] = True
-            self._produced[slot] = 1   # the prefill-sampled token
-            req.produced = 1
+            req.resume_tokens = None
+            req.produced += 1          # the prefill-sampled token
+            self._produced[slot] = req.produced
             self._slot_req[slot] = req
             now = time.monotonic()
             with self._cv:
                 req.t_first = now
                 if emit_first:
                     req.tokens.append(first_token)
-                if req.budget <= 1:
+                if req.produced >= req.budget:
                     self._retire_slot_locked(slot)
                 self._cv.notify_all()
             self._m["ttft"].observe(now - req.t_submit, self._tags)
             if emit_first:
                 self._m["tokens"].inc(1, self._tags)
+                self._fault_token_tick(1)
         with self._cv:
             self._publish_occupancy_locked()
         return True
@@ -920,6 +1086,7 @@ class InflightBatchEngine:
             self._m["ttft"].observe(now - req.t_submit, self._tags)
         if emit:
             self._m["tokens"].inc(1, self._tags)
+            self._fault_token_tick(1)
 
     def _grow_or_preempt(self) -> None:
         """Before a decode step every active slot needs a page for its
@@ -977,6 +1144,7 @@ class InflightBatchEngine:
             self._grow_or_preempt()
         if not self._active.any():
             return False
+        self._fault_step_tick()
         if self._pool is not None:
             self._sync_device_tables()
             active_now = self._active.copy()
@@ -1026,6 +1194,7 @@ class InflightBatchEngine:
             self._cv.notify_all()
         if emitted:
             self._m["tokens"].inc(emitted, self._tags)
+            self._fault_token_tick(emitted)
         if retired:
             with self._cv:
                 self._publish_occupancy_locked()

@@ -24,8 +24,6 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-_GET_TIMEOUT_S = 60.0
-
 
 def publish_kv(kv: Dict[str, Any], true_len: int,
                first_token: int, **meta: Any) -> Dict[str, Any]:
@@ -46,10 +44,21 @@ def publish_kv(kv: Dict[str, Any], true_len: int,
 def adopt_kv(handoff: Dict[str, Any]) -> Dict[str, Any]:
     """Resolve a handoff descriptor back into K/V arrays. By-reference
     when this process produced them; arena-backed ``device_put`` rebuild
-    otherwise. Bounded: a dead prefill replica must fail the request,
-    not wedge the decode engine's admission path."""
+    otherwise. Bounded by ``serve_kv_adopt_timeout_s``: a dead prefill
+    replica raises typed ``KVAdoptTimeoutError`` — which the router
+    classifies and answers by RE-RUNNING prefill on a healthy replica —
+    instead of wedging the decode engine's admission path."""
     import ray_tpu
+    from ray_tpu._private.config import config
+    from ray_tpu.exceptions import GetTimeoutError, KVAdoptTimeoutError
 
-    k, v = ray_tpu.get([handoff["k_ref"], handoff["v_ref"]],
-                       timeout=_GET_TIMEOUT_S)
+    timeout_s = float(config.serve_kv_adopt_timeout_s)
+    try:
+        k, v = ray_tpu.get([handoff["k_ref"], handoff["v_ref"]],
+                           timeout=timeout_s)
+    except GetTimeoutError as e:
+        raise KVAdoptTimeoutError(
+            f"KV handoff refs unresolvable within "
+            f"serve_kv_adopt_timeout_s={timeout_s}s (prefill replica "
+            f"dead?)", timeout_s=timeout_s) from e
     return {"k": k, "v": v}

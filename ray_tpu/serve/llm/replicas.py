@@ -106,7 +106,9 @@ def _ensure_metrics_reporter() -> None:
 
 def normalize_request(request: Any) -> Dict[str, Any]:
     """Accept either the direct dict ``{"prompt": [ids], "n": int,
-    "seed": int}`` or the HTTP proxy payload (``{"json": {...}}``)."""
+    "seed": int}`` or the HTTP proxy payload (``{"json": {...}}``).
+    ``generated`` (optional) marks a migrated request resuming after
+    tokens another replica already produced and delivered."""
     if isinstance(request, dict) and "json" in request \
             and isinstance(request["json"], dict):
         request = request["json"]
@@ -118,6 +120,7 @@ def normalize_request(request: Any) -> Dict[str, Any]:
         "prompt": [int(t) for t in request["prompt"]],
         "n": int(request["n"]) if request.get("n") else None,
         "seed": int(request.get("seed") or 0),
+        "generated": [int(t) for t in (request.get("generated") or [])],
     }
 
 
@@ -155,22 +158,27 @@ class LLMReplica:
     def __call__(self, request: Any) -> Dict[str, Any]:
         req = normalize_request(request)
         tokens = self._engine.generate(req["prompt"], req["n"],
-                                       req["seed"])
+                                       req["seed"],
+                                       generated=req["generated"])
         return {"tokens": tokens}
 
     def generate_stream(self, request: Any) -> Iterator[List[int]]:
         """Generator of token chunks (the handle's streaming path);
         closing the stream (client disconnect) cancels the engine
-        request and frees its slot / KV blocks."""
+        request and frees its slot / KV blocks. A request carrying
+        ``generated`` (a migrated stream resuming here) continues at
+        the next token — the resumed prefix is never re-emitted."""
         req = normalize_request(request)
-        rid = self._engine.submit(req["prompt"], req["n"], req["seed"])
+        rid = self._engine.submit(req["prompt"], req["n"], req["seed"],
+                                  generated=req["generated"])
         return _EngineStream(self._engine, rid)
 
     # Decoupled submit/poll API: the high-QPS client path (one collect
     # RPC serves every session parked on this replica).
     def submit(self, request: Any) -> str:
         req = normalize_request(request)
-        return self._engine.submit(req["prompt"], req["n"], req["seed"])
+        return self._engine.submit(req["prompt"], req["n"], req["seed"],
+                                   generated=req["generated"])
 
     def drain(self, req_id: str, max_wait_s: float = 0.5):
         return self._engine.drain(req_id, max_wait_s)
@@ -427,6 +435,22 @@ class DecodeReplica:
 
     def decode_stream(self, handoff: Dict[str, Any]) -> Iterator[List[int]]:
         rid = self.submit_prefilled(handoff)
+        return _EngineStream(self._engine, rid)
+
+    def resume_stream(self, request: Any) -> Iterator[List[int]]:
+        """Adopt a MIGRATED stream whose previous decode replica died:
+        no KV handoff exists anymore, but the request carries the
+        prompt plus every token already delivered (prefill's first
+        token included), so this engine re-prefills locally and
+        continues at the next position — bit-identically, without a
+        prefill-pool round trip."""
+        req = normalize_request(request)
+        if not req["generated"]:
+            raise ValueError(
+                "resume_stream needs 'generated' (the tokens already "
+                "delivered, first token included)")
+        rid = self._engine.submit(req["prompt"], req["n"], req["seed"],
+                                  generated=req["generated"])
         return _EngineStream(self._engine, rid)
 
     def drain(self, req_id: str, max_wait_s: float = 0.5):

@@ -62,7 +62,22 @@ class LLMRouter:
         self._decode = decode
         self._llm = llm
 
+    def _re_prefill(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """Re-run prefill on a (fresh pick of a) healthy prefill
+        replica after the original handoff became unresolvable.
+        Deterministic in (prompt, seed): the new handoff carries the
+        SAME first token and identical KV, so retrying decode with it
+        is bit-identical."""
+        from ray_tpu.serve.migration import note_migration
+
+        note_migration(self._prefill.deployment_name)
+        return self._prefill.prefill.remote(req).result(
+            timeout=_ROUTER_TIMEOUT_S)
+
     def __call__(self, request: Any) -> Dict[str, Any]:
+        from ray_tpu import exceptions
+        from ray_tpu._private.config import config
+
         req = normalize_request(request)
         if self._llm is not None:
             return self._llm.remote(req).result(
@@ -71,8 +86,24 @@ class LLMRouter:
             timeout=_ROUTER_TIMEOUT_S)
         if (handoff.get("n") or 2) <= 1:
             return {"tokens": [handoff["first_token"]]}
-        rest = self._decode.decode.remote(handoff).result(
-            timeout=_ROUTER_TIMEOUT_S)
+        limit = max(0, int(config.serve_request_max_migrations))
+        attempts = 0
+        while True:
+            try:
+                rest = self._decode.decode.remote(handoff).result(
+                    timeout=_ROUTER_TIMEOUT_S)
+                break
+            except exceptions.KVAdoptTimeoutError as e:
+                # The prefill replica owning the KV refs died before the
+                # decode pool adopted them: re-run prefill elsewhere and
+                # retry decode instead of failing the request.
+                if attempts >= limit:
+                    raise exceptions.RequestMigrationExhaustedError(
+                        f"KV handoff unresolvable after {attempts} "
+                        f"re-prefills (serve_request_max_migrations="
+                        f"{limit})", migrations=attempts) from e
+                attempts += 1
+                handoff = self._re_prefill(req)
         return {"tokens": [handoff["first_token"]] + rest["tokens"]}
 
     def generate_stream(self, request: Any) -> Iterator[List[int]]:
@@ -82,17 +113,60 @@ class LLMRouter:
         AND the decode-stream open run EAGERLY (at stream start, not
         first pull) so overload/validation errors reach the ingress
         before it commits a 200 — the shed contract holds for both
-        deployment modes, not just combined."""
+        deployment modes, not just combined.
+
+        Every inner stream is opened with a migration rewriter: a pool
+        replica dying mid-stream re-opens on a healthy replica and
+        continues at the next token. A request arriving WITH
+        ``generated`` is itself a resume (this router replica replaced
+        one that died mid-stream): it skips prefill — the delivered
+        tokens already cover it — and continues on the decode (or
+        combined) pool directly."""
+        from ray_tpu import exceptions
+        from ray_tpu._private.config import config
+        from ray_tpu.serve.migration import (
+            disagg_decode_resume, llm_stream_resume,
+        )
+
         req = normalize_request(request)
         if self._llm is not None:
-            return self._llm.generate_stream.remote_gen(req)
+            return self._llm.generate_stream.remote_gen(
+                req, _resume=llm_stream_resume(req))
+        if req["generated"]:
+            resume_req = {"prompt": req["prompt"], "n": req["n"],
+                          "seed": req["seed"],
+                          "generated": req["generated"]}
+            return self._decode.resume_stream.remote_gen(
+                resume_req, _resume=llm_stream_resume(
+                    resume_req, method="resume_stream"))
         handoff = self._prefill.prefill.remote(req).result(
             timeout=_ROUTER_TIMEOUT_S)
         if (handoff.get("n") or 2) <= 1:
             return iter([[handoff["first_token"]]])
-        return _DisaggStream(handoff["first_token"],
-                             self._decode.decode_stream.remote_gen(
-                                 handoff))
+        limit = max(0, int(config.serve_request_max_migrations))
+        attempts = 0
+        while True:
+            try:
+                inner = self._decode.decode_stream.remote_gen(
+                    handoff, _resume=disagg_decode_resume(handoff))
+                break
+            except exceptions.KVAdoptTimeoutError as e:
+                if attempts >= limit:
+                    raise exceptions.RequestMigrationExhaustedError(
+                        f"KV handoff unresolvable after {attempts} "
+                        f"re-prefills (serve_request_max_migrations="
+                        f"{limit})", migrations=attempts) from e
+                attempts += 1
+                handoff = self._re_prefill(req)
+        return _DisaggStream(handoff["first_token"], inner)
+
+    def serve_stats(self) -> Dict[str, Any]:
+        """Router-process migration tally (streams migrate INSIDE the
+        router process, where the pool handles live) — surfaced through
+        the replica stats RPC so the chaos bench can sum it."""
+        from ray_tpu.serve.migration import migration_stats
+
+        return migration_stats()
 
     def check_health(self) -> bool:
         return True

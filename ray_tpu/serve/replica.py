@@ -39,6 +39,11 @@ class _AsyncGenIter:
         fut = asyncio.run_coroutine_threadsafe(
             self._agen.__anext__(), self._loop)
         try:
+            # raylint: disable-next=unbounded-wait (waits on the
+            # replica's OWN user generator; the consumer side bounds
+            # each pull with the handle's stream item timeout and
+            # cancels the stream — which closes the generator — on
+            # timeout or disconnect)
             return fut.result()
         except StopAsyncIteration:
             raise StopIteration from None
@@ -55,6 +60,7 @@ class Replica:
         self.replica_id = replica_id
         self._ongoing = 0
         self._total = 0
+        self._draining = False
         self._lock = threading.Lock()
         # One persistent event loop for the replica's async user code.
         self._loop = asyncio.new_event_loop()
@@ -94,11 +100,26 @@ class Replica:
             # Submit to the replica's persistent loop — NOT a fresh
             # asyncio.run() loop per call, which broke any deployment
             # sharing async state across requests.
+            # raylint: disable-next=unbounded-wait (waits on the
+            # replica's OWN user coroutine; the caller bounds the RPC
+            # with the handle/ingress request timeout)
             result = asyncio.run_coroutine_threadsafe(
                 result, self._loop).result()
         return result
 
+    def _check_admission(self):
+        """A draining replica refuses NEW work typed — the handle
+        re-picks a healthy replica transparently. Streams already open
+        keep being served (that is what the drain waits for)."""
+        if self._draining:
+            from ray_tpu.exceptions import ReplicaDrainingError
+
+            raise ReplicaDrainingError(
+                f"replica {self.replica_id} of {self.deployment_name} "
+                "is draining", replica_id=self.replica_id)
+
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
+        self._check_admission()
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -123,6 +144,7 @@ class Replica:
         generator / async generator / iterator. Returns the stream id
         the caller pulls with ``stream_next``. The stream counts as one
         ongoing request until exhausted (autoscaling signal)."""
+        self._check_admission()
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -211,6 +233,32 @@ class Replica:
             if self._streams.pop(stream_id, None) is not None:
                 self._ongoing -= 1
 
+    # ------------------------------------------------------------- draining
+
+    def drain(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Rolling-restart drain: stop admitting new requests/streams
+        (``ReplicaDrainingError`` — the handle re-picks), then wait up
+        to ``timeout_s`` (default ``config.serve_drain_timeout_s``) for
+        in-flight work to finish. Returns ``{"drained": bool,
+        "ongoing": int}`` — stragglers past the budget hand off through
+        the same migration path as a crash when the controller kills
+        this replica."""
+        import time
+
+        if timeout_s is None:
+            from ray_tpu._private.config import config
+
+            timeout_s = float(config.serve_drain_timeout_s)
+        self._draining = True
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        while True:
+            with self._lock:
+                ongoing = self._ongoing
+            if ongoing <= 0 or time.monotonic() >= deadline:
+                return {"drained": ongoing <= 0, "ongoing": ongoing,
+                        "replica_id": self.replica_id}
+            time.sleep(0.05)
+
     # ----------------------------------------------------------------- stats
 
     def stats(self) -> Dict[str, Any]:
@@ -225,9 +273,13 @@ class Replica:
                 extra = dict(fn() or {})
             except Exception:
                 extra = {}
+        import os
+
         with self._lock:
             extra.update({"ongoing": self._ongoing, "total": self._total,
-                          "replica_id": self.replica_id})
+                          "replica_id": self.replica_id,
+                          "pid": os.getpid(),
+                          "draining": self._draining})
         return extra
 
     def check_health(self) -> bool:

@@ -227,11 +227,15 @@ def test_gcs_subprocess_sigkill_mid_workload_recovers(subprocess_cluster):
         msg="node rejoined restarted gcs subprocess")
 
     # The in-flight get completed (bounded by its own timeout, which it
-    # must come in far under).
-    th.join(timeout=90)
+    # must come in far under). The wall budget is load-aware: on a
+    # single-core box the redial/re-registration storm timeshares with
+    # the 4s actor task itself, so the same recovery legitimately takes
+    # longer than on a multi-core runner.
+    budget = 60 if (os.cpu_count() or 1) >= 2 else 85
+    th.join(timeout=budget + 25)
     assert not th.is_alive(), "in-flight get hung across the GCS kill"
     assert result.get("value") == "done", result.get("error")
-    assert result["elapsed"] < 60
+    assert result["elapsed"] < budget
 
     # Durable state recovered from gcs_storage.
     assert kv.get(b"survives") == b"yes"

@@ -1,0 +1,470 @@
+"""Serve-tier fault tolerance: crash-transparent migration of in-flight
+requests with bit-identical resume.
+
+Layers under test, bottom up:
+
+- engine: a failed/stopped engine turns in-flight requests into durable
+  resume descriptors (``EngineFailedError``), and ``submit(generated=)``
+  continues a request bit-identically (per-request ``fold_in(seed,
+  position)`` sampling keys), both KV layouts, greedy and sampled;
+- handle/router: a replica death mid-stream re-opens the stream on a
+  healthy replica from the tokens already DELIVERED client-side (never a
+  duplicate, never a gap), via deterministic fault injection
+  (``die:after_tokens``) and a real SIGKILL;
+- unary calls migrate (retry-from-scratch is exact: nothing delivered);
+- controller: rolling-restart ``drain`` — redeploys recycle every
+  replica with zero failed in-flight requests; fault stats recorded;
+- kv_transfer: a dead prefill replica's unresolvable handoff raises
+  typed ``KVAdoptTimeoutError`` bounded by ``serve_kv_adopt_timeout_s``;
+- plain (non-LLM) streams WITHOUT a resume rewriter keep today's
+  fail-loud typed behavior under mid-stream SIGKILL.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private.config import config
+from ray_tpu.exceptions import (
+    EngineFailedError, KVAdoptTimeoutError, RayActorError,
+    ReplicaDrainingError, WorkerCrashedError,
+)
+from ray_tpu.serve.llm import EngineConfig, build_llm_app
+from ray_tpu.serve.llm.engine import InflightBatchEngine
+from ray_tpu.serve.llm.replicas import _build_model
+
+ENGINE_CONFIG = dict(
+    preset="tiny", model_overrides={"dtype": "float32"},
+    max_slots=4, max_len=64, prompt_buckets=(16,), max_new_tokens=16)
+
+PROMPT = [5, 9, 2, 11, 3]
+N = 10
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ctx = ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
+    serve.start(http_port=None)
+    yield ctx
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _make_engine(**overrides) -> InflightBatchEngine:
+    ec = EngineConfig.from_dict(dict(ENGINE_CONFIG, **overrides))
+    cfg, params = _build_model(ec)
+    return InflightBatchEngine(params, cfg, ec)
+
+
+def _controller():
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def _replicas_of(name):
+    return ray_tpu.get(_controller().get_replicas.remote(name),
+                       timeout=30)
+
+
+def _pids_of(name):
+    out = {}
+    for r in _replicas_of(name):
+        s = ray_tpu.get(r.stats.remote(), timeout=30)
+        out[s["pid"]] = s
+    return out
+
+
+# ---------------------------------------------------------------- engine
+
+
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["reserved", "paged"])
+@pytest.mark.parametrize("sampling", [{}, {"temperature": 0.8, "top_k": 5}],
+                         ids=["greedy", "sampled"])
+def test_engine_resume_bit_identical(paged, sampling):
+    """submit(generated=ref[:k]) continues exactly where an undisturbed
+    run would be — the recompute-preemption invariant extended to
+    cross-engine resume, both KV layouts, greedy AND sampled."""
+    eng = _make_engine(paged_kv=paged, **sampling)
+    try:
+        ref = eng.generate(PROMPT, N, seed=3)
+        assert len(ref) == N
+        for k in (1, 4, N - 1):
+            resumed = eng.generate(PROMPT, N, seed=3, generated=ref[:k])
+            assert resumed == ref[k:], (k, resumed, ref)
+    finally:
+        eng.stop()
+
+
+def test_engine_step_failure_poisons_with_resume_descriptor():
+    """fault_inject="step_error:after=K": the failing step turns every
+    in-flight request into an EngineFailedError CARRYING a resume
+    descriptor; replaying the descriptor on a fresh engine completes
+    the stream bit-identically; the failed engine still serves new
+    requests (poison is per-request, not per-engine)."""
+    ref_eng = _make_engine()
+    try:
+        ref = ref_eng.generate(PROMPT, N, seed=0)
+    finally:
+        ref_eng.stop()
+
+    eng = _make_engine(fault_inject="step_error:after=4")
+    try:
+        rid = eng.submit(PROMPT, N, seed=0)
+        got, err = [], None
+        try:
+            for chunk in eng.stream(rid):
+                got.extend(chunk)
+        except EngineFailedError as e:
+            err = e
+        assert err is not None, "fault injection never fired"
+        assert err.reason == "step_failure"
+        d = err.descriptor
+        assert d["prompt"] == PROMPT and d["seed"] == 0
+        assert d["max_tokens"] == N
+        # The descriptor's generated prefix matches the reference run.
+        assert d["generated"] == ref[:len(d["generated"])]
+        # Delivered tokens are a prefix of generated: resuming from the
+        # DELIVERED count never duplicates, never gaps.
+        assert got == d["generated"][:len(got)]
+
+        # The engine survived the poisoned step.
+        assert eng.generate(PROMPT, 4, seed=0) == ref[:4]
+    finally:
+        eng.stop()
+
+    resumed = _make_engine()
+    try:
+        out = resumed.generate(d["prompt"], d["max_tokens"], d["seed"],
+                               generated=d["generated"])
+        assert d["generated"] + out == ref
+    finally:
+        resumed.stop()
+
+
+def test_engine_stop_and_dump_inflight_descriptors():
+    """engine.stop() with requests in flight errors them with
+    reason="engine_stopped" resume descriptors (not a bare
+    RuntimeError); dump_inflight() exposes the same descriptors for
+    drain-time handoff."""
+    eng = _make_engine()
+    rid = eng.submit(PROMPT, N, seed=1)
+    # Let a few tokens land so the descriptor is mid-flight, not empty.
+    deadline = time.time() + 30
+    got = []
+    while time.time() < deadline and len(got) < 2:
+        got.extend(eng.drain(rid, max_wait_s=0.5)["tokens"])
+    assert got, "engine produced nothing"
+    dump = eng.dump_inflight()
+    assert len(dump) == 1
+    assert dump[0]["prompt"] == PROMPT
+    assert dump[0]["generated"][:len(got)] == got
+    eng.stop()
+    with pytest.raises(EngineFailedError) as ei:
+        eng.drain(rid, max_wait_s=0.5)
+    assert ei.value.reason == "engine_stopped"
+    assert ei.value.descriptor["prompt"] == PROMPT
+
+
+def test_fault_inject_config_fallback():
+    """The ``serve_fault_inject`` config knob arms engines that were
+    built WITHOUT an explicit EngineConfig.fault_inject (same-process
+    fallback for tests and the chaos bench)."""
+    config.set("serve_fault_inject", "step_error:after=2")
+    try:
+        eng = _make_engine()
+    finally:
+        config.set("serve_fault_inject", "")
+    try:
+        with pytest.raises(EngineFailedError):
+            eng.generate(PROMPT, N, seed=0)
+    finally:
+        eng.stop()
+
+    with pytest.raises(ValueError, match="unknown serve_fault_inject"):
+        _make_engine(fault_inject="explode:after=1")
+
+
+# ------------------------------------------------- streams under crashes
+
+
+@pytest.mark.parametrize("sampling", [{}, {"temperature": 0.8, "top_k": 5}],
+                         ids=["greedy", "sampled"])
+def test_stream_survives_engine_replica_death(serve_cluster, sampling):
+    """die:after_tokens SIGKILLs the engine replica mid-stream; the
+    router migrates the stream to the surviving replica and the client
+    sees the exact undisturbed token sequence — greedy and sampled."""
+    ref_eng = _make_engine(**sampling)
+    try:
+        ref = ref_eng.generate(PROMPT, N, seed=5)
+    finally:
+        ref_eng.stop()
+
+    name = "llmdie" + ("s" if sampling else "g")
+    handle = serve.run(
+        build_llm_app(dict(ENGINE_CONFIG, fault_inject="die:after_tokens=8",
+                           **sampling),
+                      mode="combined", name=name, num_replicas=2),
+        route_prefix=f"/{name}")
+    try:
+        chunks = list(handle.generate_stream.remote_gen(
+            {"prompt": PROMPT, "n": N, "seed": 5}))
+        flat = [t for c in chunks for t in c]
+        assert flat == ref, (flat, ref)
+        # The stream migrated inside the router replica; its tally is
+        # surfaced through the replica stats RPC.
+        migrations = sum(
+            s.get("request_migrations_total", 0)
+            for s in _pids_of(name).values())
+        assert migrations >= 1
+        # The controller detected the death and recorded the restart.
+        fs = ray_tpu.get(_controller().fault_stats.remote(), timeout=30)
+        assert fs["replica_restarts_total"] >= 1
+    finally:
+        serve.delete(name)
+        serve.delete(f"{name}-engine")
+
+
+def test_stream_survives_real_sigkill(serve_cluster):
+    """No fault injection: a real mid-stream SIGKILL of the serving
+    engine replica, with the stream opened straight against the pool
+    handle (migration happens in THIS process) — output bit-identical,
+    migration counted locally."""
+    from ray_tpu.serve.handle import DeploymentHandle
+    from ray_tpu.serve.migration import llm_stream_resume, migration_stats
+
+    ref_eng = _make_engine()
+    try:
+        ref = ref_eng.generate(PROMPT, N, seed=0)
+    finally:
+        ref_eng.stop()
+
+    serve.run(build_llm_app(ENGINE_CONFIG, mode="combined",
+                            name="llmkill", num_replicas=2),
+              route_prefix="/llmkill")
+    try:
+        pool = DeploymentHandle("llmkill-engine", "generate_stream")
+        req = {"prompt": PROMPT, "n": N, "seed": 0}
+        before = migration_stats()["request_migrations_total"]
+        gen = pool.remote_gen(req, _resume=llm_stream_resume(req))
+        # Kill the serving replica BEFORE the first pull: nothing is
+        # delivered yet, so the client-side tally forces a clean resume
+        # (and the batched first pull of a fast tiny model can't race
+        # the whole stream past the kill).
+        pid = ray_tpu.get(gen._replica.stats.remote(), timeout=30)["pid"]
+        os.kill(pid, signal.SIGKILL)
+        got = [list(chunk) for chunk in gen]
+        flat = [t for c in got for t in c]
+        assert flat == ref, (flat, ref)
+        after = migration_stats()["request_migrations_total"]
+        assert after >= before + 1
+    finally:
+        serve.delete("llmkill")
+        serve.delete("llmkill-engine")
+
+
+def test_disaggregated_stream_survives_decode_death(serve_cluster):
+    """Disaggregated mode: SIGKILL the decode replica serving the
+    stream; the router's resume rewriter re-prefills prompt + delivered
+    locally on the surviving decode replica (resume_stream) and the
+    client stream completes bit-identically."""
+    ref_eng = _make_engine()
+    try:
+        ref = ref_eng.generate(PROMPT, N, seed=0)
+    finally:
+        ref_eng.stop()
+
+    handle = serve.run(
+        build_llm_app(ENGINE_CONFIG, mode="disaggregated", name="llmdis",
+                      num_decode_replicas=2),
+        route_prefix="/llmdis")
+    try:
+        gen = handle.generate_stream.remote_gen(
+            {"prompt": PROMPT, "n": N, "seed": 0})
+        got = [list(next(gen))]           # the prefill (TTFT) token
+        # Find the decode replica with the live stream and kill it.
+        busy = [s["pid"] for s in _pids_of("llmdis-decode").values()
+                if s.get("ongoing", 0) > 0]
+        assert busy, "no decode replica holds the stream"
+        for pid in busy:
+            os.kill(pid, signal.SIGKILL)
+        for chunk in gen:
+            got.append(list(chunk))
+        flat = [t for c in got for t in c]
+        assert flat == ref, (flat, ref)
+    finally:
+        serve.delete("llmdis")
+        serve.delete("llmdis-prefill")
+        serve.delete("llmdis-decode")
+
+
+def test_kv_adopt_timeout_typed(serve_cluster):
+    """adopt_kv on refs whose producer is gone raises typed
+    KVAdoptTimeoutError bounded by serve_kv_adopt_timeout_s — not a
+    60s-hardcoded wedge of the decode admission path."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.worker import ObjectRef
+    from ray_tpu.serve.llm.kv_transfer import adopt_kv
+
+    ghost = ObjectRef(ObjectID.from_random())
+    config.set("serve_kv_adopt_timeout_s", 0.5)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(KVAdoptTimeoutError) as ei:
+            adopt_kv({"k_ref": ghost, "v_ref": ghost,
+                      "length": 5, "first_token": 1})
+        assert time.monotonic() - t0 < 30
+        assert ei.value.timeout_s == 0.5
+    finally:
+        config.set("serve_kv_adopt_timeout_s", 60.0)
+
+
+# --------------------------------------------- plain deployments + drain
+
+
+@serve.deployment(num_replicas=2, name="ft-unary")
+class _SlowEcho:
+    def __call__(self, x):
+        time.sleep(1.0)
+        return x
+
+
+def test_unary_migration_on_replica_death(serve_cluster):
+    """A unary call in flight on a SIGKILLed replica is resubmitted to
+    the survivor (retry-from-scratch is exact: nothing was delivered)
+    and counted as a migration."""
+    from ray_tpu.serve.migration import migration_stats
+
+    handle = serve.run(_SlowEcho.bind(), http_port=None)
+    try:
+        # Warm both replicas so stats expose pids.
+        handle.remote("warm").result(timeout=60)
+        config.set("serve_request_max_migrations", 10)
+        before = migration_stats()["request_migrations_total"]
+        resp = handle.remote("payload")
+        time.sleep(0.3)
+        busy = [s["pid"] for s in _pids_of("ft-unary").values()
+                if s.get("ongoing", 0) > 0]
+        assert busy, "no replica reports the in-flight request"
+        for pid in busy:
+            os.kill(pid, signal.SIGKILL)
+        assert resp.result(timeout=120) == "payload"
+        assert migration_stats()["request_migrations_total"] >= before + 1
+    finally:
+        config.set("serve_request_max_migrations", 3)
+        serve.delete("ft-unary")
+
+
+@serve.deployment(num_replicas=1, name="ft-plainstream")
+class _Ticker:
+    def ticks(self, n):
+        for i in range(int(n)):
+            time.sleep(0.2)
+            yield i
+
+
+def test_plain_stream_sigkill_raises_typed(serve_cluster):
+    """A generic (non-LLM) stream has no resume rewriter: a mid-stream
+    replica SIGKILL surfaces typed actor-death errors — fail-loud, not
+    a wedge, and not silent truncation."""
+    handle = serve.run(_Ticker.bind(), http_port=None)
+    try:
+        gen = handle.ticks.remote_gen(50)
+        assert next(gen) == 0
+        pid = ray_tpu.get(gen._replica.stats.remote(), timeout=30)["pid"]
+        os.kill(pid, signal.SIGKILL)
+        with pytest.raises((RayActorError, WorkerCrashedError)):
+            for _ in gen:
+                pass
+    finally:
+        serve.delete("ft-plainstream")
+
+
+def test_drained_replica_sheds_typed_and_stats(serve_cluster):
+    """A draining replica refuses NEW work with ReplicaDrainingError
+    (typed — the handle re-picks on it) while reporting draining=True;
+    drain() returns once in-flight work finishes."""
+    @serve.deployment(num_replicas=1, name="ft-drain")
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    serve.run(Echo.bind(), http_port=None)
+    try:
+        (replica,) = _replicas_of("ft-drain")
+        out = ray_tpu.get(replica.drain.remote(1.0), timeout=30)
+        assert out["drained"] is True and out["ongoing"] == 0
+        assert ray_tpu.get(replica.stats.remote(),
+                           timeout=30)["draining"] is True
+        with pytest.raises(ReplicaDrainingError):
+            ray_tpu.get(replica.handle_request.remote(
+                "__call__", ("x",), {}), timeout=30)
+    finally:
+        serve.delete("ft-drain")
+
+
+def test_redeploy_drains_zero_failed_inflight(serve_cluster):
+    """A redeploy (serve.run on an existing name) recycles every
+    replica through the drain path: requests in flight on the old
+    generation all complete, new traffic lands on the new generation,
+    and the controller records the drain durations."""
+    @serve.deployment(num_replicas=2, name="ft-redeploy")
+    class Gen1:
+        def __call__(self, x):
+            time.sleep(0.5)
+            return ("g1", x)
+
+    @serve.deployment(num_replicas=2, name="ft-redeploy")
+    class Gen2:
+        def __call__(self, x):
+            return ("g2", x)
+
+    handle = serve.run(Gen1.bind(), http_port=None)
+    try:
+        handle.remote(0).result(timeout=60)
+        fs0 = ray_tpu.get(_controller().fault_stats.remote(), timeout=30)
+        results, errors = [], []
+
+        def issue(i):
+            try:
+                results.append(handle.remote(i).result(timeout=120))
+            except BaseException as e:  # pragma: no cover - fail below
+                errors.append(e)
+
+        threads = [threading.Thread(target=issue, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)          # requests in flight on gen 1
+        serve.run(Gen2.bind(), http_port=None)
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert len(results) == 8
+        # New traffic reaches generation 2.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if handle.remote("x").result(timeout=60) == ("g2", "x"):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("redeploy never switched traffic to gen 2")
+        # Both old replicas went through the drain path.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            fs = ray_tpu.get(_controller().fault_stats.remote(),
+                             timeout=30)
+            if len(fs["drain_duration_s"]) >= \
+                    len(fs0["drain_duration_s"]) + 2:
+                break
+            time.sleep(0.2)
+        assert len(fs["drain_duration_s"]) >= \
+            len(fs0["drain_duration_s"]) + 2, fs
+    finally:
+        serve.delete("ft-redeploy")
