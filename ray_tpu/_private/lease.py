@@ -35,10 +35,13 @@ import os
 import pickle
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import protocol
 from ray_tpu._private.spec_template import invalidate_wire, spec_wire
+
+if TYPE_CHECKING:
+    from ray_tpu._private.worker import CoreWorker
 
 TPU = "TPU"
 
@@ -165,7 +168,7 @@ class _ShapeState:
 class LeaseManager:
     """Per-CoreWorker lease table + direct submission engine."""
 
-    def __init__(self, worker):
+    def __init__(self, worker: "CoreWorker"):
         from ray_tpu._private.config import config
 
         self._w = worker
@@ -730,7 +733,11 @@ class LeaseManager:
                 "backlog": max(1, backlog),
             })
         except BaseException:
-            self._lease_denied(key)
+            # Defer: callers reach here synchronously from under
+            # self._lock (submit path), and _lease_denied re-acquires it
+            # (non-reentrant) and can fall a queued wave back over a
+            # fresh blocking connect. On the executor it runs lock-free.
+            self._exec_submit(self._lease_denied, key)
             return
         fut.add_done_callback(
             lambda f: self._exec_submit(self._on_lease_reply, key, t0, f))

@@ -1,19 +1,17 @@
 """blocking-under-lock: RPCs / sleeps / subprocess / socket ops inside a
-``with <lock>:`` body, directly or one call deep."""
+``with <lock>:`` body, directly or transitively through the whole-program
+call graph."""
 
 from __future__ import annotations
 
 import ast
-import re
-from typing import Dict, List, Optional, Tuple
+from typing import List
 
+from ray_tpu._private.lint.callgraph import fid_str
 from ray_tpu._private.lint.core import (
     Project,
-    Source,
     Violation,
-    call_name,
     unparse,
-    walk_calls,
 )
 
 RULE = "blocking-under-lock"
@@ -22,7 +20,10 @@ EXPLAIN = """\
 blocking-under-lock — a call that can block on the outside world (RPC
 round trip, sleep, subprocess spawn/wait, raw socket I/O, future/thread
 wait) executed while holding a lock, either directly in the ``with``
-body or one call deep into a same-module helper.
+body or transitively through any chain of calls the whole-program call
+graph can resolve (cross-module included — a GCS handler holding
+``_obj_lock`` that calls through ``inline_objects`` into a socket send
+is a finding even though the blocking op is two modules away).
 
 Why it matters here: this is the exact shape of the r7 deferred-reply
 hang. A node-manager handler held the pool lock across work that waited
@@ -32,10 +33,11 @@ single slow process turned into a node-wide wedge. Under a lock, latency
 is not additive, it is multiplicative: every waiter inherits it.
 
 What it flags inside a with-lock body: ``time.sleep``, ``ray.get``,
-``.request(...)`` RPCs, ``subprocess.*`` / ``Popen`` (and helpers that
-spawn, e.g. ``_spawn_worker``, found via the one-call-deep summary),
+``.request(...)`` RPCs, ``subprocess.*`` / ``Popen``,
 ``.communicate``/``.wait``/``.join``/``.result``, socket
-``connect/sendall/recv/recv_into/accept``.
+``connect/sendall/recv/recv_into/accept`` — reached directly or via any
+resolvable callee chain (the violation carries the witness path; see
+``--json``).
 
 What it deliberately does NOT flag:
 - ``conn.notify`` / ``conn.reply`` / ``reply_error`` — the protocol
@@ -44,6 +46,9 @@ What it deliberately does NOT flag:
 - ``cv.wait()`` inside ``with cv:`` — the Condition idiom RELEASES the
   lock while waiting; that is the correct way to wait.
 - ``proc.kill()`` / ``os.kill`` — signal sends, non-blocking.
+- chains whose terminal op carries a ``raylint: disable`` for this rule
+  at the op site — a reasoned suppression at the origin covers every
+  caller.
 
 Fix: move the blocking call out of the critical section (snapshot state
 under the lock, act outside — see _acquire_chips's victim-kill pattern),
@@ -51,75 +56,11 @@ or bound it and suppress with a comment explaining why holding the lock
 across it is safe.
 """
 
-_BLOCKING_EXACT = {"time.sleep", "ray.get", "ray_tpu.get",
-                   "socket.create_connection"}
-_BLOCKING_LEAVES = {"request", "communicate", "wait", "join", "result",
-                    "sendall", "connect", "recv", "recv_into", "accept",
-                    "wait_for", "run", "check_call", "check_output",
-                    "Popen"}
-# `.run(...)`/`.wait(...)` only count when the receiver smells like
-# subprocess/process/future/socket/thread territory, to keep dict-ish
-# and domain `.run()` methods out.
-_NEEDS_RECEIVER_HINT = {"run", "check_call", "check_output"}
-_RECEIVER_HINT = re.compile(r"subprocess")
-
-
-def _is_blocking(call: ast.Call) -> Optional[str]:
-    name = call_name(call)
-    if name in _BLOCKING_EXACT:
-        return name
-    head, _, leaf = name.rpartition(".")
-    if leaf in _BLOCKING_LEAVES and head:
-        if leaf in _NEEDS_RECEIVER_HINT and \
-                not _RECEIVER_HINT.search(head):
-            return None
-        return name
-    if name == "Popen":
-        return name
-    return None
-
-
-def _fn_key(src: Source, fn: ast.AST) -> Tuple[str, str]:
-    cls = src.enclosing_class(fn)
-    return (cls.name if cls else "", fn.name)
-
-
-def _build_summaries(src: Source) -> Dict[Tuple[str, str], List[tuple]]:
-    """(class, func) -> [(blocking-name, line), ...] for direct calls."""
-    out: Dict[Tuple[str, str], List[tuple]] = {}
-    for node in ast.walk(src.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        entries = []
-        for call in walk_calls(node):
-            if src.enclosing_function(call) is not node:
-                continue  # belongs to a nested def
-            b = _is_blocking(call)
-            if b is not None:
-                entries.append((b, call.lineno))
-        out[_fn_key(src, node)] = entries
-    return out
-
-
-def _resolve_callee(src: Source, call: ast.Call,
-                    ctx: ast.AST) -> Optional[Tuple[str, str]]:
-    """``self._foo()`` -> method of the enclosing class;
-    ``foo()`` -> module function."""
-    func = call.func
-    if isinstance(func, ast.Attribute) and \
-            isinstance(func.value, ast.Name) and func.value.id == "self":
-        cls = src.enclosing_class(ctx)
-        if cls is not None:
-            return (cls.name, func.attr)
-    if isinstance(func, ast.Name):
-        return ("", func.id)
-    return None
-
 
 def check_project(project: Project) -> List[Violation]:
+    cg = project.callgraph()
     out: List[Violation] = []
     for src in project.control_plane():
-        summaries = _build_summaries(src)
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.With):
                 continue
@@ -133,40 +74,31 @@ def check_project(project: Project) -> List[Violation]:
                 continue
             lock_texts = {unparse(i.context_expr) for i, _ in lock_items}
             lock_desc = ", ".join(sorted(lock_texts))
-            for call in walk_calls(node):
-                # A call in a nested def runs later, not under the lock.
-                fn_of_call = src.enclosing_function(call)
-                fn_of_with = src.enclosing_function(node)
-                if fn_of_call is not fn_of_with:
+            for call, how in cg.blocking_in_with(src, node, lock_texts):
+                if src.is_node_suppressed(RULE, call, node):
                     continue
-                # Skip calls in the with-items themselves (the acquire).
-                if any(call is sub or call in ast.walk(i.context_expr)
-                       for i, _ in lock_items
-                       for sub in [i.context_expr]):
+                if how[0] == "direct":
+                    out.append(src.violation(
+                        RULE, call,
+                        f"{how[1]}(...) while holding {lock_desc}: "
+                        f"every thread queueing on the lock inherits "
+                        f"this call's latency"))
                     continue
-                name = call_name(call)
-                # Condition idiom: cv.wait()/wait_for() under `with cv:`
-                # releases the lock while waiting.
-                recv = name.rpartition(".")[0]
-                if name.rsplit(".", 1)[-1] in ("wait", "wait_for") and \
-                        recv in lock_texts:
-                    continue
-                direct = _is_blocking(call)
-                if direct is not None:
-                    if not src.is_node_suppressed(RULE, call, node):
-                        out.append(src.violation(
-                            RULE, call,
-                            f"{direct}(...) while holding {lock_desc}: "
-                            f"every thread queueing on the lock inherits "
-                            f"this call's latency"))
-                    continue
-                callee = _resolve_callee(src, call, node)
-                if callee and summaries.get(callee):
-                    bname, bline = summaries[callee][0]
-                    if not src.is_node_suppressed(RULE, call, node):
-                        out.append(src.violation(
-                            RULE, call,
-                            f"call to {callee[1]}() while holding "
-                            f"{lock_desc} blocks via {bname} "
-                            f"(line {bline})"))
+                _, callee, item = how
+                origin = cg.origin(callee, item)
+                if origin is not None:
+                    orel, _oline, onode = origin
+                    osrc = project.by_rel.get(orel)
+                    if osrc is not None and \
+                            osrc.is_node_suppressed(RULE, onode):
+                        continue  # reasoned suppression at the op site
+                chain = ([f"{src.rel}:{call.lineno}: holds {lock_desc}, "
+                          f"calls {fid_str(callee)}"]
+                         + cg.chain(callee, item))
+                out.append(src.violation(
+                    RULE, call,
+                    f"call to {fid_str(callee)}() while holding "
+                    f"{lock_desc} blocks via {item[1]} "
+                    f"({chain[-1].rsplit(': ', 1)[0]})",
+                    chain=chain))
     return out

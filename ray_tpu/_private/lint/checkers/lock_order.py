@@ -1,15 +1,13 @@
 """lock-order: build the static lock-acquisition graph from nested
-``with`` scopes (one call deep) and report cycles + non-reentrant
-self-nesting."""
+``with`` scopes and transitive call-graph acquisition summaries, and
+report cycles + non-reentrant self-nesting."""
 
 from __future__ import annotations
 
-import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.lint.core import (
     Project,
-    Source,
     Violation,
 )
 
@@ -17,18 +15,20 @@ RULE = "lock-order"
 
 EXPLAIN = """\
 lock-order — the static half of lockdep. Every ``with <lock>:`` nested
-(syntactically, or one call deep through a same-module helper) inside
-another ``with <lock>:`` contributes an edge outer→inner to a global
-lock-acquisition graph spanning the control plane's lock sites
-(node_manager, lease, worker, collective, device_objects, gcs,
-protocol). A cycle in that graph is a deadlock waiting for the right
+inside another ``with <lock>:`` — syntactically, or through ANY chain of
+calls the whole-program call graph resolves (cross-module helpers,
+``self.``-dispatch, attribute receivers), or inside a manual
+``.acquire()``/``.release()`` region — contributes an edge outer→inner
+to a global lock-acquisition graph spanning every lock site in the
+repo. A cycle in that graph is a deadlock waiting for the right
 interleaving: thread 1 holds A wanting B while thread 2 holds B wanting
 A. Unlike a data race this never shows up in single-threaded tests —
 only under production concurrency, as a silent wedge.
 
 Also flagged: nesting a NON-reentrant ``threading.Lock`` inside itself
-(directly or via a helper that re-acquires it) — that one deadlocks on
-the first execution of the path, no interleaving needed.
+(directly or via any resolvable call chain that re-acquires it) — that
+one deadlocks on the first execution of the path, no interleaving
+needed.
 
 Lock identity is the creation site (``Class._attr`` / module global),
 i.e. lockdep "classes", so per-instance locks of the same class are one
@@ -39,8 +39,10 @@ still the same latent cycle.
 The runtime twin: ``ray_tpu._private.lockdep`` (knob
 RAY_TPU_LOCKDEP_ENABLED) wraps threading.Lock/RLock, records the ACTUAL
 acquisition order, and dumps the witness cycle — it catches orders the
-static view can't see (callbacks, cross-module flows); this checker
-catches orders the tests never execute. Run both.
+static view can't see (callbacks, function-valued dispatch); this
+checker catches orders the tests never execute. Run both — and diff
+them: ``--emit-lock-graph`` exports this graph as JSON, and the
+reconciliation test fails on any runtime edge the static graph lacks.
 
 Fix: pick one global order and restructure (snapshot under one lock,
 act under the other), or collapse the two locks into one.
@@ -49,94 +51,24 @@ act under the other), or collapse the two locks into one.
 Edge = Tuple[str, str]
 
 
-def _with_locks(project: Project, src: Source,
-                node: ast.With) -> List[str]:
-    out = []
-    for item in node.items:
-        lid = project.resolve_lock(src, item.context_expr, node)
-        if lid is not None:
-            out.append(lid)
-    return out
-
-
-def _fn_key(src: Source, fn: ast.AST) -> Tuple[str, str]:
-    cls = src.enclosing_class(fn)
-    return (cls.name if cls else "", fn.name)
-
-
-def _callee_key(src: Source, call: ast.Call,
-                ctx: ast.AST) -> Optional[Tuple[str, str]]:
-    func = call.func
-    if isinstance(func, ast.Attribute) and \
-            isinstance(func.value, ast.Name) and func.value.id == "self":
-        cls = src.enclosing_class(ctx)
-        if cls is not None:
-            return (cls.name, func.attr)
-    if isinstance(func, ast.Name):
-        return ("", func.id)
-    return None
-
-
 def check_project(project: Project) -> List[Violation]:
-    # fn -> locks acquired anywhere inside (for the one-call-deep hop)
-    fn_locks: Dict[Tuple[str, Tuple[str, str]], Set[str]] = {}
-    sources = project.control_plane()
-    for src in sources:
-        for node in ast.walk(src.tree):
-            if isinstance(node, ast.With):
-                fn = src.enclosing_function(node)
-                if fn is None:
-                    continue
-                key = (src.rel, _fn_key(src, fn))
-                fn_locks.setdefault(key, set()).update(
-                    _with_locks(project, src, node))
-
-    # (outer, inner) -> (src, line, how) for the first sighting
-    edges: Dict[Edge, Tuple[Source, int, str]] = {}
+    cg = project.callgraph()
     violations: List[Violation] = []
 
-    def add_edge(outer: str, inner: str, src: Source, line: int,
-                 how: str, node: ast.AST) -> None:
-        if outer == inner:
-            if not project.lock_is_reentrant(outer) and \
-                    not outer.startswith("?") and ":" not in outer:
-                if not src.is_node_suppressed(RULE, node):
-                    violations.append(Violation(
-                        RULE, src.rel, line,
-                        f"non-reentrant lock {outer} re-acquired while "
-                        f"held ({how}): deadlocks on first execution",
-                        src.line_text(line)))
-            return
-        edges.setdefault((outer, inner), (src, line, how))
+    for lid, src, node, line, how, chain in cg.self_nests():
+        if project.lock_is_reentrant(lid) or lid.startswith("?") or \
+                ":" in lid:
+            continue
+        if src.is_node_suppressed(RULE, node):
+            continue
+        violations.append(Violation(
+            RULE, src.rel, line,
+            f"non-reentrant lock {lid} re-acquired while held ({how}): "
+            f"deadlocks on first execution",
+            src.line_text(line), chain=tuple(chain) or None))
 
-    for src in sources:
-        for node in ast.walk(src.tree):
-            if not isinstance(node, ast.With):
-                continue
-            outer_locks = _with_locks(project, src, node)
-            if not outer_locks:
-                continue
-            fn_of_with = src.enclosing_function(node)
-            for sub in ast.walk(node):
-                if sub is node:
-                    continue
-                if isinstance(sub, ast.With) and \
-                        src.enclosing_function(sub) is fn_of_with:
-                    for inner in _with_locks(project, src, sub):
-                        for outer in outer_locks:
-                            add_edge(outer, inner, src, sub.lineno,
-                                     "nested with", sub)
-                elif isinstance(sub, ast.Call) and \
-                        src.enclosing_function(sub) is fn_of_with:
-                    callee = _callee_key(src, sub, node)
-                    if callee is None:
-                        continue
-                    for inner in fn_locks.get((src.rel, callee), ()):
-                        for outer in outer_locks:
-                            add_edge(outer, inner, src, sub.lineno,
-                                     f"via {callee[1]}()", sub)
-
-    # Cycle hunt over the class graph.
+    # (outer, inner) -> (rel, line, how, chain) for the first sighting.
+    edges = cg.lock_graph()
     graph: Dict[str, Set[str]] = {}
     for (a, b) in edges:
         graph.setdefault(a, set()).add(b)
@@ -164,14 +96,28 @@ def check_project(project: Project) -> List[Violation]:
             continue
         reported.add(key)
         sites = []
+        chain: List[str] = []
+        sup = False
         for i in range(len(cyc) - 1):
             e = edges.get((cyc[i], cyc[i + 1]))
             if e is not None:
+                esrc = project.by_rel.get(e[0])
+                if esrc is not None and esrc.suppressed(RULE, e[1]):
+                    # A reasoned suppression on ANY edge of the cycle
+                    # dismisses the whole witness (the justification —
+                    # e.g. a gate lock serializing both paths — is about
+                    # the cycle, not one edge).
+                    sup = True
                 sites.append(f"{cyc[i]}→{cyc[i + 1]} at "
-                             f"{e[0].rel}:{e[1]} ({e[2]})")
-        src0, line0, _ = edges[(cyc[0], cyc[1])]
+                             f"{e[0]}:{e[1]} ({e[2]})")
+                chain.extend(e[3])
+        if sup:
+            continue
+        rel0, line0, _, _ = edges[(cyc[0], cyc[1])]
+        src0 = project.by_rel.get(rel0)
         violations.append(Violation(
-            RULE, src0.rel, line0,
+            RULE, rel0, line0,
             "lock-order cycle (deadlock witness): " + "; ".join(sites),
-            src0.line_text(line0)))
+            src0.line_text(line0) if src0 else "",
+            chain=tuple(chain) or None))
     return violations

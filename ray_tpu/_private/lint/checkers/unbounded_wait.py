@@ -1,12 +1,15 @@
 """unbounded-wait: blocking waits without a timeout/deadline in
-control-plane paths."""
+control-plane paths — direct, or reached transitively through helpers
+outside the control plane via the whole-program call graph."""
 
 from __future__ import annotations
 
 import ast
-from typing import List, Optional
+from typing import List, Optional, Set, Tuple
 
+from ray_tpu._private.lint.callgraph import fid_str
 from ray_tpu._private.lint.core import (
+    CONTROL_PLANE,
     Project,
     Violation,
     call_name,
@@ -39,13 +42,23 @@ What it flags (control-plane files only):
 - socket ``.recv``/``.recv_into``/``.accept`` in functions that never
   call ``.settimeout``
 
+Transitive findings: a control-plane call into a helper OUTSIDE the
+control plane whose body (or further callees) parks with no bound is
+flagged at the control-plane call site, with the witness chain attached.
+Bounds propagate through the chain — a helper whose wait is bounded only
+by its own ``timeout=None`` parameter is unbounded exactly at the call
+sites that don't supply one.
+
 What it deliberately does NOT flag: waits that pass any timeout (even a
 variable — bounding is the caller's contract), dict ``.get(key)`` (has
 a positional key argument), and ``.request`` on a receiver the file
 binds to ``_GcsChannel`` — that channel applies the
 ``gcs_rpc_timeout_s`` bound by default (opting out requires the
 explicit ``UNBOUNDED`` sentinel, which is a visible decision at the
-call site). Raw ``protocol.Conn.request`` stays flagged.
+call site). Raw ``protocol.Conn.request`` stays flagged. Chains that
+pass through another control-plane function are skipped (the finding —
+or its reasoned suppression — lives at the deeper site), as are chains
+whose terminal op carries this rule's suppression.
 
 Fix: thread a deadline through (config knobs exist for the collective
 paths: RAY_TPU_COLLECTIVE_OP_TIMEOUT_S etc.). A dedicated daemon thread
@@ -106,8 +119,79 @@ def _fn_calls_settimeout(fn: ast.AST) -> bool:
     return False
 
 
+def _transitive(project: Project, src, node: ast.Call,
+                seen: Set[tuple]) -> List[Violation]:
+    """Flag a control-plane call whose NON-control-plane callee
+    transitively parks with no bound."""
+    cg = project.callgraph()
+    out: List[Violation] = []
+    if cg._under_await_direct(src, node):
+        return out  # awaited: the loop's business (see async-blocking)
+    for callee, offset in cg.resolve(src, node):
+        info = cg.functions.get(callee)
+        if info is None or info.src.rel in CONTROL_PLANE:
+            continue  # flagged (or reasoned about) at the deeper site
+        if info.is_async:
+            continue
+        for item in sorted(cg.summary(callee)):
+            # Witness entries live under the item as stored in the
+            # callee's summary; lift conditional bounds for the verdict
+            # but keep the original key for witness lookups.
+            wit_item = item
+            if item[0] == "unbounded?":
+                item = cg._lift(item, _CallEdge(node, offset),
+                                _NO_PARAMS, info)
+                if item is None or item[0] != "unbounded":
+                    continue
+            elif item[0] != "unbounded":
+                continue
+            if any(cg.functions[f].src.rel in CONTROL_PLANE
+                   for f in cg.chain_fids(callee, wit_item)
+                   if f in cg.functions):
+                continue  # the chain re-enters the control plane
+            origin = cg.origin(callee, wit_item)
+            if origin is None:
+                continue
+            orel, _oline, onode = origin
+            key = (src.rel, node.lineno, item[1], orel)
+            if key in seen:
+                continue
+            seen.add(key)
+            osrc = project.by_rel.get(orel)
+            if osrc is not None and osrc.is_node_suppressed(RULE, onode):
+                continue
+            if src.is_node_suppressed(RULE, node):
+                continue
+            chain = ([f"{src.rel}:{node.lineno}: calls "
+                      f"{fid_str(callee)}"] + cg.chain(callee, wit_item))
+            out.append(src.violation(
+                RULE, node,
+                f"call into {fid_str(callee)}() parks with no bound: "
+                f"{item[1]}(...) at {chain[-1].rsplit(': ', 1)[0]}",
+                chain=chain))
+    return out
+
+
+class _CallEdge:
+    """Just enough of callgraph.Edge for _lift at a checker call site."""
+
+    def __init__(self, call: ast.Call, offset: int):
+        self.call = call
+        self.offset = offset
+
+
+class _NoParams:
+    params: list = []
+    kwonly: list = []
+    defaults: dict = {}
+
+
+_NO_PARAMS = _NoParams()
+
+
 def check_project(project: Project) -> List[Violation]:
     out: List[Violation] = []
+    seen_transitive: Set[tuple] = set()
     for src in project.control_plane():
         bounded = _bounded_channels(src)
         for node in ast.walk(src.tree):
@@ -149,6 +233,11 @@ def check_project(project: Project) -> List[Violation]:
                     msg = f"socket {name}() in a function that never " \
                           f"sets a socket timeout"
             if msg is None:
+                # Not a direct wait — but the callee may park, cross-
+                # module, with no bound. Awaited calls are the loop's
+                # business (async-blocking covers those paths).
+                out.extend(_transitive(project, src, node,
+                                       seen_transitive))
                 continue
             if src.is_node_suppressed(RULE, node):
                 continue

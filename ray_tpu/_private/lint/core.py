@@ -93,6 +93,18 @@ CONTROL_PLANE = (
     "ray_tpu/serve/replica.py",
     "ray_tpu/serve/handle.py",
     "ray_tpu/serve/migration.py",
+    # The GCS launcher supervises the out-of-process GCS from inside
+    # init()/shutdown() — its bootstrap poll and terminate/kill waits
+    # gate every cluster start and teardown.
+    "ray_tpu/_private/gcs_launcher.py",
+    # The spec-template byte patcher runs on the worker-submit hot path
+    # (every classic submit rides a patched template).
+    "ray_tpu/_private/spec_template.py",
+    # The dashboard agent's collectors run daemon threads inside every
+    # NM and fan in over control-plane sockets.
+    "ray_tpu/dashboard/agent.py",
+    # Back-compat ingress shim (re-exports the HTTP proxy).
+    "ray_tpu/serve/proxy.py",
 )
 
 # The subset where a swallowed GangMemberDiedError / RayActorError turns
@@ -118,6 +130,10 @@ class Violation:
     line: int          # 1-based, for display only
     message: str
     snippet: str       # stripped source of the flagged line
+    # Witness call path for transitive (call-graph) findings: one hop per
+    # entry, the concrete op last. Display-only — NOT part of the
+    # baseline key (resolution improvements must not invalidate it).
+    chain: Optional[Tuple[str, ...]] = None
 
     @property
     def key(self) -> str:
@@ -141,6 +157,9 @@ class Source:
             for child in ast.iter_child_nodes(node):
                 child._raylint_parent = node  # type: ignore[attr-defined]
         self.suppressions = self._parse_suppressions(text)
+        # (line, rule) pairs that actually suppressed a would-be finding
+        # this run — the stale-suppression checker flags the rest.
+        self.suppression_hits: Set[Tuple[int, str]] = set()
 
     def _parse_suppressions(self, text: str) -> Dict[int, Set[str]]:
         out: Dict[int, Set[str]] = {}
@@ -183,6 +202,7 @@ class Source:
     def suppressed(self, rule: str, *linenos: int) -> bool:
         for ln in linenos:
             if rule in self.suppressions.get(ln, ()):
+                self.suppression_hits.add((ln, rule))
                 return True
         return False
 
@@ -207,10 +227,12 @@ class Source:
     def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
         return self.enclosing(node, ast.ClassDef)
 
-    def violation(self, rule: str, node: ast.AST, message: str) -> Violation:
+    def violation(self, rule: str, node: ast.AST, message: str,
+                  chain: Optional[Sequence[str]] = None) -> Violation:
         line = getattr(node, "lineno", 1)
         return Violation(rule=rule, path=self.rel, line=line,
-                         message=message, snippet=self.line_text(line))
+                         message=message, snippet=self.line_text(line),
+                         chain=tuple(chain) if chain else None)
 
     def is_node_suppressed(self, rule: str, node: ast.AST,
                            *extra_nodes: ast.AST) -> bool:
@@ -267,12 +289,34 @@ def walk_calls(node: ast.AST) -> Iterable[ast.Call]:
 # ------------------------------------------------------------------- project
 
 class Project:
-    """The linted file set plus lazily-built cross-file indices."""
+    """The linted file set plus lazily-built cross-file indices.
 
-    def __init__(self, sources: List[Source]):
+    ``depth`` bounds the call-graph summary propagation (None = full
+    fixed point; 1 = one call deep, the pre-callgraph behavior).
+    """
+
+    def __init__(self, sources: List[Source],
+                 depth: Optional[int] = None):
         self.sources = sources
+        self.depth = depth
         self.by_rel = {s.rel: s for s in sources}
         self._lock_registry: Optional[Dict[str, dict]] = None
+        self._callgraph = None
+        # Rules actually executed this run (set by run_lint) — the
+        # stale-suppression checker only judges suppressions of rules
+        # that ran.
+        self.executed_rules: Optional[Set[str]] = None
+        # (rel, line, attr) -> {"candidates": [...], "text": str, "node"}
+        # — lock attribute references that matched multiple classes and
+        # receiver-type inference could not disambiguate (reported by
+        # the lock-ambiguous rule).
+        self.ambiguous_locks: Dict[Tuple[str, int, str], dict] = {}
+
+    def callgraph(self):
+        if self._callgraph is None:
+            from ray_tpu._private.lint.callgraph import CallGraph
+            self._callgraph = CallGraph(self, depth=self.depth)
+        return self._callgraph
 
     def control_plane(self) -> List[Source]:
         return [s for s in self.sources if s.rel in CONTROL_PLANE]
@@ -283,7 +327,11 @@ class Project:
     # ---- lock registry: every `x = threading.Lock()/RLock()/...` site
 
     _LOCK_CTORS = {"Lock": False, "RLock": True, "Condition": True,
-                   "Semaphore": False, "BoundedSemaphore": False}
+                   "Semaphore": False, "BoundedSemaphore": False,
+                   # _thread.allocate_lock(): lockdep's own un-wrapped
+                   # state lock — registered so the static graph's edges
+                   # into it reference a known creation site.
+                   "allocate_lock": False}
 
     def lock_registry(self) -> Dict[str, dict]:
         """lock_id -> {"reentrant": bool, "source": rel, "line": int,
@@ -340,15 +388,41 @@ class Project:
             if lid in reg:
                 return lid
         if isinstance(expr, ast.Attribute):
-            # `other._lock`: match by attribute name across classes; an
-            # ambiguous attr maps to every class that defines it being
-            # conflated — acceptable for a linter, precise enough here.
+            # `mod._lock`: a module-level lock referenced through an
+            # import resolves to its registered creation site.
+            recv = unparse(expr.value)
+            if recv and "." not in recv and not recv.startswith("self"):
+                tmod = self.callgraph()._resolve_module(
+                    recv, self.callgraph().canonical(src.modname))
+                if tmod is not None:
+                    lid = f"{tmod}.{expr.attr}"
+                    if lid in reg:
+                        return lid
+            # `other._lock`: match by attribute name across classes, then
+            # disambiguate with the call graph's receiver-type inference.
+            # A site inference cannot pin down is reported under the
+            # lock-ambiguous rule and gets a site-scoped identity — it
+            # must NOT conflate distinct locks into one graph node.
             matches = [lid for lid, info in reg.items()
                        if info["attr"] == expr.attr]
             if len(matches) == 1:
                 return matches[0]
             if matches:
-                return f"?.{expr.attr}"
+                cg = self.callgraph()
+                types = cg.infer_expr_types(src, expr.value, ctx_node)
+                cands = []
+                for t in types:
+                    for c in cg._mro(t):
+                        lid = f"{c[0]}.{c[1]}.{expr.attr}"
+                        if lid in reg and lid not in cands:
+                            cands.append(lid)
+                if len(cands) == 1:
+                    return cands[0]
+                self.ambiguous_locks.setdefault(
+                    (src.rel, getattr(expr, "lineno", 0), expr.attr),
+                    {"text": text, "node": expr,
+                     "candidates": sorted(cands or matches)})
+                return f"{src.modname}:{text}"
         low = text.lower()
         if "lock" in low or low.endswith("_cv") or low in ("cv", "cond"):
             return f"{src.modname}:{text}"
@@ -361,13 +435,17 @@ class Project:
 
 # ----------------------------------------------------------------- discovery
 
-_EXCLUDE_DIRS = {"__pycache__", "lint"}
+_EXCLUDE_DIRS = {"__pycache__"}
+# The linter does not lint itself (its fixtures would trip it) — but the
+# exclusion is the linter's OWN package path, not any directory that
+# happens to be named `lint` (a future ray_tpu/<pkg>/lint/ must be
+# linted like everything else).
+_LINT_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
 def collect_sources(paths: Optional[Sequence[str]] = None,
                     root: str = REPO_ROOT) -> List[Source]:
-    """Parse every .py under ``paths`` (default: the ray_tpu package).
-    The linter does not lint itself (its fixtures would trip it)."""
+    """Parse every .py under ``paths`` (default: the ray_tpu package)."""
     files: List[str] = []
     for p in (paths or [os.path.join(root, "ray_tpu")]):
         p = os.path.abspath(p)
@@ -375,8 +453,10 @@ def collect_sources(paths: Optional[Sequence[str]] = None,
             files.append(p)
             continue
         for dirpath, dirnames, filenames in os.walk(p):
-            dirnames[:] = sorted(d for d in dirnames
-                                 if d not in _EXCLUDE_DIRS)
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in _EXCLUDE_DIRS and
+                os.path.join(dirpath, d) != _LINT_PKG_DIR)
             for fn in sorted(filenames):
                 if fn.endswith(".py"):
                     files.append(os.path.join(dirpath, fn))
@@ -396,25 +476,34 @@ def collect_sources(paths: Optional[Sequence[str]] = None,
 
 def all_checkers():
     from ray_tpu._private.lint.checkers import (
+        async_blocking,
         blocking_under_lock,
         config_drift,
         exception_swallow,
         hold_release,
+        lock_ambiguous,
         lock_order,
+        stale_suppression,
         unbounded_wait,
     )
+    # stale_suppression MUST run last: it judges which suppressions the
+    # other checkers actually consulted this run.
     return [unbounded_wait, blocking_under_lock, lock_order,
-            hold_release, exception_swallow, config_drift]
+            lock_ambiguous, async_blocking, hold_release,
+            exception_swallow, config_drift, stale_suppression]
 
 
 def run_lint(paths: Optional[Sequence[str]] = None,
              root: str = REPO_ROOT,
-             rules: Optional[Set[str]] = None) -> List[Violation]:
-    project = Project(collect_sources(paths, root=root))
+             rules: Optional[Set[str]] = None,
+             depth: Optional[int] = None) -> List[Violation]:
+    project = Project(collect_sources(paths, root=root), depth=depth)
+    project.executed_rules = set()
     violations: List[Violation] = []
     for checker in all_checkers():
         if rules and checker.RULE not in rules:
             continue
+        project.executed_rules.add(checker.RULE)
         violations.extend(checker.check_project(project))
     violations.sort(key=lambda v: (v.path, v.line, v.rule))
     return violations

@@ -365,3 +365,18 @@ def reset() -> None:
 def graph_snapshot() -> Dict[str, Set[str]]:
     with _state_lock:
         return {k: set(v) for k, v in _graph.items()}
+
+
+def witnessed_graph() -> List[Dict[str, str]]:
+    """Runtime-observed lock-order edges with their witness sites, for
+    static<->runtime reconciliation against raylint's
+    ``--emit-lock-graph`` output. Each entry:
+    ``{"held": <class-key>, "acquired": <class-key>, "site": file:line}``
+    where class keys are creation sites (``ray_tpu/...py:lineno``) and
+    ``site`` is where the inner acquire happened while the outer was
+    held — the witness stack's tip."""
+    with _state_lock:
+        return [{"held": a, "acquired": b,
+                 "site": _edge_sites.get((a, b), "?")}
+                for a, edges in sorted(_graph.items())
+                for b in sorted(edges)]

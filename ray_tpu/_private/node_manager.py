@@ -972,8 +972,8 @@ class NodeManager:
                 break
         if target is None:
             target = live[next(self._zygote_rr) % len(live)]
-            # raylint: disable-next=unbounded-wait (in-process lock held
-            # only around a 10s-bounded socket conversation)
+            # In-process lock, held only around a 10s-bounded socket
+            # conversation — a bounded wait, not a park.
             target["lock"].acquire()
         try:
             return self._zygote_fork_locked(target, req)
@@ -994,8 +994,8 @@ class NodeManager:
             _, f = z["io"]
             f.write((json.dumps(req) + "\n").encode())
             f.flush()
-            # raylint: disable-next=unbounded-wait (socket carries a 10s
-            # settimeout from connect time)
+            # The socket carries a 10s settimeout from connect time, so
+            # this read is bounded.
             line = f.readline()
             if not line:
                 raise OSError("zygote connection closed")

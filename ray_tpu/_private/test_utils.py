@@ -15,17 +15,20 @@ import random
 import signal
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ray_tpu._private.node_manager import NodeManager
 
 
-def worker_pids(nm) -> List[int]:
+def worker_pids(nm: "NodeManager") -> List[int]:
     """PIDs of every live worker subprocess on a node."""
     with nm._lock:
         return [w.proc.pid for w in nm._workers.values()
                 if w.proc.poll() is None]
 
 
-def busy_worker_pids(nm) -> List[int]:
+def busy_worker_pids(nm: "NodeManager") -> List[int]:
     """PIDs of workers currently executing a task or hosting an actor.
 
     Leased workers count as busy: direct-transport tasks run on them

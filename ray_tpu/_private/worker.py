@@ -2415,7 +2415,8 @@ def _error_from_reason(reason: Optional[str]) -> BaseException:
 # ---------------------------------------------------------------- driver glue
 
 _global_worker: Optional[CoreWorker] = None
-_global_cluster = None   # _LocalCluster when we started the control plane
+# _LocalCluster when we started the control plane
+_global_cluster: Optional["_LocalCluster"] = None
 _init_lock = threading.RLock()
 
 
@@ -2534,6 +2535,10 @@ def init(address=None, num_cpus=None, num_tpus=None, resources=None,
                 "ray_tpu.init() called twice; pass ignore_reinit_error=True "
                 "or call ray_tpu.shutdown() first")
         if address in (None, "local"):
+            # raylint: disable-next=blocking-under-lock (init() IS the
+            # blocking bootstrap — standing up GCS, node manager, and
+            # their sockets. _init_lock exists precisely to make
+            # concurrent init()/shutdown() callers wait for it.)
             _global_cluster = _LocalCluster(
                 num_cpus, num_tpus, resources, object_store_memory,
                 system_config)
@@ -2571,9 +2576,17 @@ def shutdown():
         if _global_worker is not None:
             from ray_tpu._private import usage
             usage.on_driver_disconnect()
+            # raylint: disable-next=blocking-under-lock (_init_lock is
+            # the init/shutdown lifecycle guard: a concurrent init()
+            # MUST block until teardown — flushes, RPC drains, thread
+            # joins included — completes; releasing mid-teardown would
+            # let a new cluster interleave with the dying one)
             _global_worker.disconnect()
             _global_worker = None
         if _global_cluster is not None:
+            # raylint: disable-next=blocking-under-lock (same lifecycle
+            # guard: teardown joins daemon threads under the lock by
+            # design)
             _global_cluster.shutdown()
             _global_cluster = None
 

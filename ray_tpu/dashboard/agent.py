@@ -33,7 +33,10 @@ import sys
 import threading
 import time
 import traceback
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ray_tpu._private.node_manager import NodeManager
 
 logger = logging.getLogger("ray_tpu.agent")
 
@@ -173,7 +176,7 @@ class NodeAgent:
     worker-table snapshots are taken under the NM lock by the NM-facing
     helpers, and all fan-out I/O happens lock-free."""
 
-    def __init__(self, nm, ring_size: int = 4096):
+    def __init__(self, nm: "NodeManager", ring_size: int = 4096):
         self._nm = nm
         self.recorder = FlightRecorder(nm.node_id, nm.session_dir,
                                        ring_size)
@@ -219,7 +222,7 @@ class NodeAgent:
         """Live workers (under the NM lock) plus dead workers' log files
         still on disk — logs must outlive the process that wrote them."""
         rows: Dict[str, Dict[str, Any]] = {}
-        nm = self._nm
+        nm: NodeManager = self._nm
         with nm._lock:
             workers = list(nm._workers.values())
         for w in workers:
@@ -329,7 +332,7 @@ class NodeAgent:
         node manager's own threads."""
         from ray_tpu._private import protocol
 
-        nm = self._nm
+        nm: NodeManager = self._nm
         with nm._lock:
             targets = [((w.worker_id.hex(), w.proc.pid,
                          w.actor_id.hex() if w.actor_id else None),
@@ -368,7 +371,7 @@ class NodeAgent:
         not one per process. Stragglers are abandoned, not waited on."""
         from ray_tpu._private import profiler, protocol
 
-        nm = self._nm
+        nm: NodeManager = self._nm
         with nm._lock:
             targets = [((w.worker_id.hex(), w.proc.pid,
                          w.actor_id.hex() if w.actor_id else None),

@@ -24,6 +24,7 @@ put/get transfers. They ride the identical event schema, so
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import contextvars
 import threading
@@ -292,6 +293,10 @@ class PendingSpan:
                   sampled=self.sampled)
 
 
+# raylint: disable-next=async-blocking (loop-safe boundary: when called
+# on an event-loop thread, the flush — GCS notify, channel lock, maybe a
+# reconnect — is shipped to the default executor; the synchronous branch
+# below only runs on plain threads, which the static pass cannot see)
 def _maybe_flush() -> None:
     global _last_flush
     now = time.time()
@@ -301,7 +306,12 @@ def _maybe_flush() -> None:
         if not due or not _buf:
             return
         _last_flush = now
-    flush_spans()
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        flush_spans()
+        return
+    loop.run_in_executor(None, flush_spans)
 
 
 def flush_spans() -> None:

@@ -51,6 +51,9 @@ LOCKDEP_MODULES = {
     "test_scheduler_scale",
     "test_gcs_fault_tolerance",
     "test_actor_leases",
+    # Static<->runtime lock-graph reconciliation needs the runtime
+    # witness recording while it drives the init/task/actor workload.
+    "test_lockgraph_reconcile",
     # The profiler's sampler/window/table locks run inside every
     # process the cluster owns (and its fan-in crosses the NM/GCS agent
     # paths) — witness its lock graph wherever its tests drive it.

@@ -1,0 +1,137 @@
+"""Static<->runtime lock-graph reconciliation.
+
+raylint's ``--emit-lock-graph`` models the project's lock-order graph
+from source; ``lockdep.witnessed_graph()`` records the edges that
+actually executed. Every runtime edge whose endpoints the static
+registry knows must appear in the static graph — a missing edge means
+the static pass has a resolution blind spot (dynamic dispatch, a
+callback registration, a lock reached through a path ``resolve`` can't
+follow), which is exactly the drift this test exists to catch before it
+becomes a missed inversion.
+
+The inverse direction is NOT asserted: the static graph legitimately
+contains edges no single test run executes.
+
+One edge class is allowlisted below rather than resolved: a
+closure-local lock held across a call to a higher-order *parameter*
+(``lazy_metrics``'s guard lock around ``factory()``). The call graph
+deliberately does not attribute nested-closure bodies to their definer
+(defining a callback is not calling it), and the callee of a bare
+parameter is call-site-dependent — both sides of that edge are
+statically invisible by design, not by accident. The allowlist names
+the lock ids, so any OTHER missing edge still fails.
+
+This module is in conftest.LOCKDEP_MODULES, so the runtime witness is
+recording while the workload drives init/tasks/actors/get/shutdown.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import lockdep
+from ray_tpu._private.lint import core
+from ray_tpu._private.lint.callgraph import emit_lock_graph
+
+
+def _static_graph():
+    project = core.Project(core.collect_sources())
+    return emit_lock_graph(project)
+
+
+# (outer lid, inner lid) pairs the static pass cannot see — see the
+# module docstring. Keyed by registry lock ids (stable across line
+# drift); only exact pairs are excused.
+KNOWN_BLIND_SPOTS = {
+    # lazy_metrics' closure guard held across factory() registering
+    # metrics under the registry lock.
+    ("ray_tpu.util.metrics.lock", "ray_tpu.util.metrics._registry_lock"),
+}
+
+
+def _drive_workload():
+    """Exercise the lock-heavy control-plane paths: scheduling, actor
+    lifecycle, object transfer, completion ingestion, shutdown."""
+
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        refs = [square.remote(i) for i in range(8)]
+        assert ray_tpu.get(refs, timeout=60) == [i * i for i in range(8)]
+        c = Counter.remote()
+        outs = [c.add.remote(1) for _ in range(4)]
+        assert ray_tpu.get(outs[-1], timeout=60) == 4
+        obj = ray_tpu.put(list(range(32)))
+        assert ray_tpu.get(obj, timeout=60)[-1] == 31
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_runtime_edges_subset_of_static_graph():
+    assert lockdep.installed(), "conftest should have installed lockdep"
+    lockdep.reset()
+    try:
+        _drive_workload()
+        witnessed = lockdep.witnessed_graph()
+    finally:
+        # Leave a clean graph for whatever module runs next either way.
+        violations = lockdep.take_violations()
+        lockdep.reset()
+    assert not violations, violations
+    assert witnessed, "workload drove the control plane; expected edges"
+
+    static = _static_graph()
+    site_to_lids = {}
+    for lid, info in static["locks"].items():
+        site_to_lids.setdefault(info["site"], set()).add(lid)
+    static_edges = {(e["outer"], e["inner"]) for e in static["edges"]}
+
+    missing = []
+    mapped = 0
+    for e in witnessed:
+        outers = site_to_lids.get(e["held"], set())
+        inners = site_to_lids.get(e["acquired"], set())
+        if not outers or not inners:
+            # A lock the static registry doesn't model (e.g. created via
+            # an alias it can't attribute): out of reconciliation scope.
+            continue
+        mapped += 1
+        if all((lo, li) in KNOWN_BLIND_SPOTS
+               for lo in outers for li in inners):
+            continue
+        if not any((lo, li) in static_edges
+                   for lo in outers for li in inners):
+            missing.append(
+                f"runtime edge {e['held']} -> {e['acquired']} "
+                f"(witnessed at {e['site']}) has no static counterpart")
+    assert mapped, (
+        "no runtime edge mapped onto the static registry — the "
+        "creation-site keys have drifted apart")
+    assert not missing, (
+        "static lock graph is missing runtime-witnessed edges "
+        "(resolution blind spot — fix callgraph.resolve or the lock "
+        "registry):\n" + "\n".join(missing))
+
+
+def test_static_graph_covers_registry_locks():
+    """Sanity on the static side alone: the export is well-formed and
+    its edges only reference locks the registry knows (or the
+    site-scoped ``?ambiguous`` identities)."""
+    static = _static_graph()
+    assert static["version"] == 1
+    known = set(static["locks"])
+    for e in static["edges"]:
+        for end in (e["outer"], e["inner"]):
+            assert end in known or end.startswith("?"), e
+        assert e["chain"], e

@@ -781,3 +781,568 @@ def test_repo_gcs_shard_locks_registered():
         lid = f"ray_tpu._private.gcs.GcsServer.{name}"
         assert lid in reg, f"missing shard lock identity {lid}"
         assert reg[lid]["reentrant"], f"{lid} must be an RLock"
+
+
+# ---------------------------------------------- whole-program call graph
+
+UTIL = "ray_tpu/util/helpers.py"          # NOT a control-plane path
+SCHED = "ray_tpu/_private/sched.py"
+OBJSTORE = "ray_tpu/_private/objstore.py"
+INGRESS = "ray_tpu/serve/ingress/app.py"  # async-blocking scope
+
+
+def test_crossmodule_blocking_under_lock_triggers(tmp_path):
+    """A control-plane with-block calling into a helper MODULE whose
+    function sleeps is flagged at the call site, chain attached."""
+    v = lint_tree(tmp_path, {
+        NM: (
+            "import threading\n"
+            "from ray_tpu.util import helpers\n"
+            "class NodeManager:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def restart(self):\n"
+            "        with self._lock:\n"
+            "            helpers.settle()\n"
+        ),
+        UTIL: (
+            "import time\n"
+            "def settle():\n"
+            "    time.sleep(1.0)\n"
+        ),
+    }, rules={"blocking-under-lock"})
+    assert rules_of(v) == ["blocking-under-lock"], v
+    assert v[0].path == NM and v[0].line == 8
+    assert v[0].chain and any("time.sleep" in hop for hop in v[0].chain)
+
+
+def test_crossmodule_blocking_under_lock_clean_helper_passes(tmp_path):
+    v = lint_tree(tmp_path, {
+        NM: (
+            "import threading\n"
+            "from ray_tpu.util import helpers\n"
+            "class NodeManager:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def restart(self):\n"
+            "        with self._lock:\n"
+            "            helpers.settle()\n"
+        ),
+        UTIL: (
+            "def settle():\n"
+            "    return 2 + 2\n"
+        ),
+    }, rules={"blocking-under-lock"})
+    assert v == [], v
+
+
+def test_crossmodule_unbounded_wait_triggers(tmp_path):
+    """A control-plane call into a non-control-plane helper that parks
+    with no bound is flagged at the control-plane call site."""
+    v = lint_tree(tmp_path, {
+        NM: (
+            "from ray_tpu.util import helpers\n"
+            "def supervise(fut):\n"
+            "    helpers.settle(fut)\n"
+        ),
+        UTIL: (
+            "def settle(fut):\n"
+            "    return fut.result()\n"
+        ),
+    }, rules={"unbounded-wait"})
+    assert rules_of(v) == ["unbounded-wait"], v
+    assert v[0].path == NM and v[0].line == 3
+    assert v[0].chain and any("fut.result" in hop for hop in v[0].chain)
+
+
+def test_crossmodule_unbounded_wait_bound_propagates(tmp_path):
+    """Bounds propagate through the chain: a helper whose wait is bound
+    by its own timeout param is unbounded exactly at call sites that
+    don't supply one."""
+    files = {
+        UTIL: (
+            "def settle(fut, timeout=None):\n"
+            "    return fut.result(timeout)\n"
+        ),
+    }
+    flagged = lint_tree(tmp_path, dict(files, **{NM: (
+        "from ray_tpu.util import helpers\n"
+        "def supervise(fut):\n"
+        "    helpers.settle(fut)\n"              # no bound supplied
+    )}), rules={"unbounded-wait"})
+    assert rules_of(flagged) == ["unbounded-wait"], flagged
+    clean = lint_tree(tmp_path, dict(files, **{NM: (
+        "from ray_tpu.util import helpers\n"
+        "def supervise(fut):\n"
+        "    helpers.settle(fut, timeout=5.0)\n"  # caller bounds it
+    )}), rules={"unbounded-wait"})
+    assert clean == [], clean
+
+
+def test_crossmodule_lock_order_try_schedule_inversion(tmp_path):
+    """The two-module inversion the old one-file pass could never see:
+    the object store calls back into the scheduler while holding its own
+    lock, while the scheduler calls into the object store under its —
+    obj->sched vs sched->obj, visible only through the call graph."""
+    v = lint_tree(tmp_path, {
+        SCHED: (
+            "import threading\n"
+            "from ray_tpu._private import objstore\n"
+            "_sched_lock = threading.Lock()\n"
+            "def _try_schedule():\n"
+            "    with _sched_lock:\n"
+            "        objstore.release_obj()\n"
+        ),
+        OBJSTORE: (
+            "import threading\n"
+            "from ray_tpu._private import sched\n"
+            "_obj_lock = threading.Lock()\n"
+            "def release_obj():\n"
+            "    with _obj_lock:\n"
+            "        pass\n"
+            "def on_task_done():\n"
+            "    with _obj_lock:\n"
+            "        sched._try_schedule()\n"
+        ),
+    }, rules={"lock-order"})
+    cycles = [x for x in v if "cycle" in x.message]
+    assert len(cycles) == 1, v
+    assert "_sched_lock" in cycles[0].message
+    assert "_obj_lock" in cycles[0].message
+    assert cycles[0].chain, "cycle must carry its witness chain"
+
+
+def test_crossmodule_lock_order_consistent_nesting_passes(tmp_path):
+    """Same two modules, but the callback happens AFTER the object lock
+    is released — no inversion, no finding."""
+    v = lint_tree(tmp_path, {
+        SCHED: (
+            "import threading\n"
+            "from ray_tpu._private import objstore\n"
+            "_sched_lock = threading.Lock()\n"
+            "def _try_schedule():\n"
+            "    with _sched_lock:\n"
+            "        objstore.release_obj()\n"
+        ),
+        OBJSTORE: (
+            "import threading\n"
+            "from ray_tpu._private import sched\n"
+            "_obj_lock = threading.Lock()\n"
+            "def release_obj():\n"
+            "    with _obj_lock:\n"
+            "        pass\n"
+            "def on_task_done():\n"
+            "    with _obj_lock:\n"
+            "        pass\n"
+            "    sched._try_schedule()\n"
+        ),
+    }, rules={"lock-order"})
+    assert [x for x in v if "cycle" in x.message] == [], v
+
+
+# ------------------------------------------------------- async-blocking
+
+def test_async_blocking_through_helper_module(tmp_path):
+    """An async ingress handler reaching time.sleep through a helper
+    MODULE is a finding — the loop stall is two files away."""
+    v = lint_tree(tmp_path, {
+        INGRESS: (
+            "from ray_tpu.util import helpers\n"
+            "async def handle(request):\n"
+            "    helpers.warmup()\n"
+        ),
+        UTIL: (
+            "import time\n"
+            "def warmup():\n"
+            "    time.sleep(0.5)\n"
+        ),
+    }, rules={"async-blocking"})
+    assert rules_of(v) == ["async-blocking"], v
+    assert v[0].path == INGRESS and v[0].line == 3
+    assert v[0].chain and any("time.sleep" in hop for hop in v[0].chain)
+
+
+def test_async_blocking_awaited_and_compute_pass(tmp_path):
+    """Awaited helpers and pure-compute helpers do not stall the loop."""
+    v = lint_tree(tmp_path, {
+        INGRESS: (
+            "import asyncio\n"
+            "from ray_tpu.util import helpers\n"
+            "async def handle(request):\n"
+            "    await asyncio.sleep(0)\n"
+            "    return helpers.shape(request)\n"
+        ),
+        UTIL: (
+            "def shape(request):\n"
+            "    return len(request)\n"
+        ),
+    }, rules={"async-blocking"})
+    assert v == [], v
+
+
+def test_async_blocking_bounded_wait_still_flagged(tmp_path):
+    """A BOUNDED wait still blocks the loop: timeout= does not discharge
+    this rule (unlike unbounded-wait)."""
+    v = lint_tree(tmp_path, {INGRESS: (
+        "async def handle(request, fut):\n"
+        "    return fut.result(timeout=5)\n"
+    )}, rules={"async-blocking"})
+    assert rules_of(v) == ["async-blocking"], v
+
+
+def test_async_blocking_out_of_scope_sync_tier_passes(tmp_path):
+    """async defs outside the asyncio tier are not this rule's business
+    (their sync call chains are covered by the other checkers)."""
+    v = lint_tree(tmp_path, {"ray_tpu/train/loop.py": (
+        "import time\n"
+        "async def train_step():\n"
+        "    time.sleep(0.1)\n"
+    )}, rules={"async-blocking"})
+    assert v == [], v
+
+
+def test_async_blocking_loop_safe_boundary_declaration(tmp_path):
+    """A helper that detects the loop and defers to an executor declares
+    itself loop-safe ON ITS DEF LINE; every async caller is covered."""
+    v = lint_tree(tmp_path, {
+        INGRESS: (
+            "from ray_tpu.util import helpers\n"
+            "async def handle(request):\n"
+            "    helpers.emit()\n"
+        ),
+        UTIL: (
+            "import asyncio\n"
+            "import time\n"
+            "# raylint: disable-next=async-blocking (defers to the\n"
+            "# default executor when called on a loop thread)\n"
+            "def emit():\n"
+            "    try:\n"
+            "        loop = asyncio.get_running_loop()\n"
+            "    except RuntimeError:\n"
+            "        _flush()\n"
+            "        return\n"
+            "    loop.run_in_executor(None, _flush)\n"
+            "def _flush():\n"
+            "    time.sleep(0.5)\n"
+        ),
+    }, rules={"async-blocking"})
+    assert v == [], v
+
+
+# ---------------------------------------------------- graph resolution
+
+def test_callgraph_resolves_import_alias(tmp_path):
+    v = lint_tree(tmp_path, {
+        NM: (
+            "import ray_tpu.util.helpers as hp\n"
+            "def supervise(fut):\n"
+            "    hp.settle(fut)\n"
+        ),
+        UTIL: (
+            "def settle(fut):\n"
+            "    return fut.result()\n"
+        ),
+    }, rules={"unbounded-wait"})
+    assert rules_of(v) == ["unbounded-wait"], v
+
+
+def test_callgraph_resolves_self_method_dispatch(tmp_path):
+    """self.-dispatch: the blocking op is two METHOD hops away."""
+    v = lint_tree(tmp_path, {NM: (
+        "import threading, time\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def restart(self):\n"
+        "        with self._lock:\n"
+        "            self._drain()\n"
+        "    def _drain(self):\n"
+        "        self._settle()\n"
+        "    def _settle(self):\n"
+        "        time.sleep(1.0)\n"
+    )}, rules={"blocking-under-lock"})
+    assert rules_of(v) == ["blocking-under-lock"], v
+    assert v[0].line == 7
+
+
+def test_callgraph_cycle_terminates_and_propagates(tmp_path):
+    """Mutually recursive helpers must not hang the fixed point, and
+    their ops still propagate out of the cycle."""
+    v = lint_tree(tmp_path, {
+        NM: (
+            "from ray_tpu.util import helpers\n"
+            "def supervise(fut):\n"
+            "    helpers.ping(fut)\n"
+        ),
+        UTIL: (
+            "def ping(fut):\n"
+            "    pong(fut)\n"
+            "def pong(fut):\n"
+            "    ping(fut)\n"
+            "    return fut.result()\n"
+        ),
+    }, rules={"unbounded-wait"})
+    assert rules_of(v) == ["unbounded-wait"], v
+
+
+def test_depth_knob_bounds_propagation(tmp_path):
+    """depth=1 approximates the old one-call-deep pass; the default full
+    fixed point sees through arbitrarily long chains."""
+    files = {
+        NM: (
+            "from ray_tpu.util import helpers\n"
+            "def supervise(fut):\n"
+            "    helpers.mid(fut)\n"
+        ),
+        UTIL: (
+            "def mid(fut):\n"
+            "    return deep(fut)\n"
+            "def deep(fut):\n"
+            "    return deeper(fut)\n"
+            "def deeper(fut):\n"
+            "    return fut.result()\n"
+        ),
+    }
+    full = lint_tree(tmp_path, files, rules={"unbounded-wait"})
+    assert rules_of(full) == ["unbounded-wait"], full
+    for rel, srctext in files.items():
+        (tmp_path / rel).write_text(srctext)
+    shallow = core.run_lint([str(tmp_path / "ray_tpu")],
+                            root=str(tmp_path),
+                            rules={"unbounded-wait"}, depth=1)
+    assert shallow == [], shallow
+
+
+# ----------------------------------------------------- stale-suppression
+
+def test_stale_suppression_flags_dead_comment(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "def fine(fut):\n"
+        "    # raylint: disable-next=unbounded-wait (stale claim)\n"
+        "    return fut.result(5)\n"   # bounded: rule does not fire
+    )})
+    stale = [x for x in v if x.rule == "stale-suppression"]
+    assert len(stale) == 1, v
+    assert "unbounded-wait" in stale[0].message
+
+
+def test_stale_suppression_quiet_when_suppression_absorbs(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "def reader(fut):\n"
+        "    # raylint: disable-next=unbounded-wait (dedicated reader)\n"
+        "    return fut.result()\n"
+    )})
+    assert [x for x in v if x.rule == "stale-suppression"] == [], v
+    assert [x for x in v if x.rule == "unbounded-wait"] == [], v
+
+
+def test_stale_suppression_flags_unknown_rule_name(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "def reader(fut):\n"
+        "    # raylint: disable-next=unbonded-wait (typo)\n"
+        "    return fut.result()\n"
+    )})
+    stale = [x for x in v if x.rule == "stale-suppression"]
+    assert len(stale) == 1, v
+    assert "unknown rule" in stale[0].message
+
+
+def test_stale_suppression_skips_rules_that_did_not_run(tmp_path):
+    """A --rule-filtered run cannot judge other rules' suppressions."""
+    v = lint_tree(tmp_path, {NM: (
+        "def fine(fut):\n"
+        "    # raylint: disable-next=unbounded-wait (stale claim)\n"
+        "    return fut.result(5)\n"
+    )}, rules={"stale-suppression", "lock-order"})
+    assert [x for x in v if x.rule == "stale-suppression"] == [], v
+
+
+# ------------------------------------------------------- lock-ambiguous
+
+def test_lock_ambiguous_untyped_receiver_flagged(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import threading\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "class GcsTable:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "def snapshot(nm):\n"
+        "    with nm._lock:\n"
+        "        return 1\n"
+    )}, rules={"lock-ambiguous"})
+    assert rules_of(v) == ["lock-ambiguous"], v
+    assert "nm._lock" in v[0].message
+
+
+def test_lock_ambiguous_annotation_disambiguates(tmp_path):
+    v = lint_tree(tmp_path, {NM: (
+        "import threading\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "class GcsTable:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "def snapshot(nm: NodeManager):\n"
+        "    with nm._lock:\n"
+        "        return 1\n"
+    )}, rules={"lock-ambiguous"})
+    assert v == [], v
+
+
+def test_ambiguous_lock_identity_does_not_conflate(tmp_path):
+    """The historical failure mode: an unresolvable attr lock collapsed
+    every ``_lock``-defining class into one graph node, manufacturing
+    false cycles. The site-scoped identity must NOT create a cycle with
+    the real locks' edges."""
+    v = lint_tree(tmp_path, {NM: (
+        "import threading\n"
+        "class NodeManager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._aux = threading.Lock()\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            with self._aux:\n"
+        "                pass\n"
+        "class GcsTable:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._aux = threading.Lock()\n"
+        "def poke(thing):\n"
+        "    with thing._aux:\n"       # untyped: NodeManager? GcsTable?
+        "        with thing._lock:\n"  # inverted order vs a()
+        "            pass\n"
+    )}, rules={"lock-order"})
+    assert [x for x in v if "cycle" in x.message] == [], v
+
+
+# ------------------------------------------------- collect_sources scope
+
+def test_collect_sources_includes_foreign_lint_dirs(tmp_path):
+    """Only the linter's OWN package is exempt from linting — a product
+    directory that happens to be named ``lint`` is still linted."""
+    rel = "ray_tpu/foo/lint/bar.py"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True)
+    p.write_text("def f():\n    return 1\n")
+    srcs = core.collect_sources([str(tmp_path / "ray_tpu")],
+                                root=str(tmp_path))
+    assert [s.rel for s in srcs] == [rel]
+
+
+def test_collect_sources_excludes_own_lint_package():
+    srcs = core.collect_sources()
+    rels = [s.rel for s in srcs]
+    assert not any(r.startswith("ray_tpu/_private/lint/") for r in rels)
+    assert any(r == "ray_tpu/_private/lockdep.py" for r in rels)
+
+
+# ------------------------------------------------------------------ CLI
+
+def _run_cli(argv, monkeypatch=None, capsys=None):
+    from ray_tpu._private.lint import __main__ as cli
+
+    rc = cli.main(argv)
+    out = capsys.readouterr().out if capsys is not None else ""
+    return rc, out
+
+
+def test_cli_json_includes_call_path(tmp_path, monkeypatch, capsys):
+    import json as _json
+
+    from ray_tpu._private.lint import __main__ as cli
+
+    for rel, text in {
+        NM: (
+            "from ray_tpu.util import helpers\n"
+            "def supervise(fut):\n"
+            "    helpers.settle(fut)\n"
+        ),
+        UTIL: (
+            "def settle(fut):\n"
+            "    return fut.result()\n"
+        ),
+    }.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    # run_lint's root default is bound to the real repo; aim the CLI's
+    # call at the fixture tree instead.
+    real_run_lint = core.run_lint
+    monkeypatch.setattr(
+        cli.core, "run_lint",
+        lambda paths, **kw: real_run_lint(paths, root=str(tmp_path),
+                                          rules=kw.get("rules"),
+                                          depth=kw.get("depth")))
+    rc, out = _run_cli(
+        [str(tmp_path / "ray_tpu"), "--no-baseline", "--json",
+         "--rule", "unbounded-wait"], capsys=capsys)
+    assert rc == 1
+    doc = _json.loads(out)
+    (v,) = doc["violations"]
+    assert v["rule"] == "unbounded-wait" and v["path"] == NM
+    assert v["chain"] and any("fut.result" in hop for hop in v["chain"])
+
+
+def test_cli_emit_lock_graph_shape(capsys):
+    import json as _json
+
+    from ray_tpu._private.lint import __main__ as cli
+
+    rc = cli.main(["--emit-lock-graph"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = _json.loads(out)
+    assert doc["version"] == 1
+    assert doc["locks"] and doc["edges"]
+    for lid, info in doc["locks"].items():
+        assert ":" in info["site"] and isinstance(info["reentrant"], bool)
+    known = set(doc["locks"])
+    for e in doc["edges"]:
+        assert e["outer"] in known or e["outer"].startswith("?")
+        assert e["at"].count(":") == 1 and e["chain"]
+
+
+def test_cli_changed_only_filters_by_git_diff(monkeypatch, capsys):
+    from ray_tpu._private.lint import __main__ as cli
+
+    fake = [
+        core.Violation("unbounded-wait", "ray_tpu/_private/gcs.py", 10,
+                       "m", "s"),
+        core.Violation("unbounded-wait", "ray_tpu/_private/lease.py", 20,
+                       "m", "s"),
+    ]
+    monkeypatch.setattr(cli.core, "run_lint",
+                        lambda *a, **k: list(fake))
+    monkeypatch.setattr(cli, "_changed_files",
+                        lambda root: {"ray_tpu/_private/lease.py"})
+    rc = cli.main(["--no-baseline", "--changed-only"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "lease.py:20" in out and "gcs.py:10" not in out
+    assert "raylint: 1 violation" in out
+
+
+def test_cli_changed_files_reads_git(tmp_path):
+    import subprocess
+
+    from ray_tpu._private.lint import __main__ as cli
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True,
+                       env={"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t",
+                            "HOME": str(tmp_path), "PATH": "/usr/bin:/bin"})
+
+    git("init", "-q", "-b", "main")
+    (tmp_path / "a.py").write_text("x = 1\n")
+    git("add", "a.py")
+    git("commit", "-qm", "seed")
+    (tmp_path / "b.py").write_text("y = 2\n")
+    git("add", "b.py")
+    assert cli._changed_files(str(tmp_path)) == {"b.py"}
